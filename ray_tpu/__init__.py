@@ -1,0 +1,67 @@
+"""ray_tpu: a TPU-native distributed execution framework.
+
+A from-scratch rebuild of the capability surface of Ray (reference:
+Nicolaus93/ray — see SURVEY.md) designed TPU-first: dynamic tasks and actors
+with ObjectRef futures and an ownership-based local runtime, plus a compiled
+dataflow-graph executor that lowers static DAGs to a single JAX program where
+dependency resolution and argument movement run as batched ops over an
+HBM-resident task/object table (the north star of BASELINE.json), and a
+jax-native parallelism layer (DP/FSDP/TP/PP/SP-CP/EP) in place of external
+NCCL integrations.
+
+Public API parity map (reference python/ray/__init__.py [unverified]):
+init/shutdown, @remote, get/put/wait/cancel/kill, ObjectRef, ActorHandle,
+get_actor, runtime context, plus subpackages dag/, data/, train/, tune/,
+serve/, rl/ (rllib), collective/, util/.
+"""
+
+from ray_tpu._private.config import GlobalConfig as _config  # noqa: F401
+from ray_tpu._private.worker import (
+    ObjectRef,
+    cancel,
+    get,
+    init,
+    is_initialized,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill
+from ray_tpu.remote_function import RemoteFunction, method, remote
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "cancel",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    "__version__",
+]
+
+
+def available_resources():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().resource_pool.available()
+
+
+def cluster_resources():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().resource_pool.total

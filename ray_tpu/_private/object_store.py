@@ -1,0 +1,271 @@
+"""In-process object store with refcounting, lineage pinning, and spilling.
+
+Plays the role of the reference's CoreWorker in-process MemoryStore plus the
+owner-side ReferenceCounter (reference: src/ray/core_worker/memory_store and
+reference_count.cc [unverified]). Objects are stored as ``SerializedObject``
+payloads (or errors); futures resolve via condition variables; when memory
+pressure passes the configured cap, sealed objects spill to disk and restore
+transparently on get — the plasma-spill analogue. The shared-memory
+cross-process path lives in ray_tpu/_native (C++).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedObject
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+)
+
+
+class _Entry:
+    __slots__ = (
+        "serialized", "error", "ready", "size", "spilled_path",
+        "local_refs", "submitted_refs", "pinned_for_lineage", "callbacks",
+        "create_time",
+    )
+
+    def __init__(self):
+        self.serialized: Optional[SerializedObject] = None
+        self.error: Optional[BaseException] = None
+        self.ready = False
+        self.size = 0
+        self.spilled_path: Optional[str] = None
+        self.local_refs = 0
+        self.submitted_refs = 0
+        self.pinned_for_lineage = False
+        self.callbacks: List[Callable[[], None]] = []
+        self.create_time = time.monotonic()
+
+
+class ObjectStore:
+    """Owner-local object table: futures, payloads, refcounts, spilling."""
+
+    def __init__(self, spill_dir: str):
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._cv = threading.Condition()
+        self._memory_used = 0
+        self._spill_dir = spill_dir
+        self._spilled_bytes = 0
+        self._restored_bytes = 0
+
+    # ------------------------------------------------------------------ puts
+    def put(self, object_id: ObjectID, serialized: SerializedObject):
+        callbacks = []
+        with self._cv:
+            entry = self._entries.setdefault(object_id, _Entry())
+            if entry.ready:
+                return  # idempotent (e.g. retry produced the same object)
+            entry.serialized = serialized
+            entry.size = serialized.total_bytes()
+            entry.ready = True
+            self._memory_used += entry.size
+            callbacks, entry.callbacks = entry.callbacks, []
+            self._cv.notify_all()
+            self._maybe_spill_locked()
+        for cb in callbacks:
+            cb()
+
+    def put_error(self, object_id: ObjectID, error: BaseException):
+        callbacks = []
+        with self._cv:
+            entry = self._entries.setdefault(object_id, _Entry())
+            if entry.ready:
+                return
+            entry.error = error
+            entry.ready = True
+            callbacks, entry.callbacks = entry.callbacks, []
+            self._cv.notify_all()
+        for cb in callbacks:
+            cb()
+
+    # ------------------------------------------------------------------ gets
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None
+            ) -> SerializedObject:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            entry = self._entries.setdefault(object_id, _Entry())
+            while not entry.ready:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"get() timed out waiting for {object_id}"
+                        )
+                self._cv.wait(remaining)
+            if entry.error is not None:
+                err = entry.error
+                if hasattr(err, "as_instanceof_cause"):
+                    raise err.as_instanceof_cause()
+                raise err
+            if entry.serialized is None:
+                return self._restore_locked(object_id, entry)
+            return entry.serialized
+
+    def peek_error(self, object_id: ObjectID) -> Optional[BaseException]:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e.error if e is not None and e.ready else None
+
+    def is_ready(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e is not None and e.ready
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            return object_id in self._entries
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [
+                    oid for oid in object_ids
+                    if (e := self._entries.get(oid)) is not None and e.ready
+                ]
+                if len(ready) >= num_returns:
+                    break
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                self._cv.wait(remaining)
+            ready_set = set(ready[:num_returns])
+            ready_list = [oid for oid in object_ids if oid in ready_set]
+            not_ready = [oid for oid in object_ids if oid not in ready_set]
+            return ready_list, not_ready
+
+    def on_ready(self, object_id: ObjectID, callback: Callable[[], None]):
+        """Invoke callback when object resolves (immediately if resolved)."""
+        with self._cv:
+            entry = self._entries.setdefault(object_id, _Entry())
+            if not entry.ready:
+                entry.callbacks.append(callback)
+                return
+        callback()
+
+    def cancel(self, object_id: ObjectID, task_id=None):
+        self.put_error(object_id, TaskCancelledError(task_id))
+
+    # ------------------------------------------------------------- refcounts
+    def add_local_ref(self, object_id: ObjectID):
+        with self._cv:
+            self._entries.setdefault(object_id, _Entry()).local_refs += 1
+
+    def remove_local_ref(self, object_id: ObjectID):
+        with self._cv:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return
+            entry.local_refs -= 1
+            self._maybe_evict_locked(object_id, entry)
+
+    def add_submitted_ref(self, object_id: ObjectID):
+        with self._cv:
+            self._entries.setdefault(object_id, _Entry()).submitted_refs += 1
+
+    def remove_submitted_ref(self, object_id: ObjectID):
+        with self._cv:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return
+            entry.submitted_refs -= 1
+            self._maybe_evict_locked(object_id, entry)
+
+    def ref_counts(self, object_id: ObjectID):
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None:
+                return (0, 0)
+            return (e.local_refs, e.submitted_refs)
+
+    def _maybe_evict_locked(self, object_id: ObjectID, entry: _Entry):
+        if (
+            entry.local_refs <= 0
+            and entry.submitted_refs <= 0
+            and not entry.pinned_for_lineage
+            and entry.ready
+        ):
+            if entry.serialized is not None:
+                self._memory_used -= entry.size
+            if entry.spilled_path:
+                try:
+                    os.unlink(entry.spilled_path)
+                except OSError:
+                    pass
+            del self._entries[object_id]
+
+    def free(self, object_ids: List[ObjectID]):
+        """Explicitly drop payloads (ray.internal.free parity)."""
+        with self._cv:
+            for oid in object_ids:
+                entry = self._entries.get(oid)
+                if entry is None or not entry.ready:
+                    continue
+                if entry.serialized is not None:
+                    self._memory_used -= entry.size
+                    entry.serialized = None
+                entry.error = ObjectLostError(oid, f"object {oid} was freed")
+
+    # -------------------------------------------------------------- spilling
+    def _maybe_spill_locked(self):
+        cap = GlobalConfig.object_store_memory_bytes
+        if self._memory_used <= cap:
+            return
+        # Spill largest-and-oldest sealed objects until under the cap.
+        candidates = sorted(
+            (
+                (oid, e) for oid, e in self._entries.items()
+                if e.ready and e.serialized is not None and e.size > 4096
+            ),
+            key=lambda kv: (-kv[1].size, kv[1].create_time),
+        )
+        os.makedirs(self._spill_dir, exist_ok=True)
+        for oid, entry in candidates:
+            if self._memory_used <= cap:
+                break
+            path = os.path.join(self._spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(entry.serialized.to_bytes())
+            entry.spilled_path = path
+            self._memory_used -= entry.size
+            self._spilled_bytes += entry.size
+            entry.serialized = None
+
+    def _restore_locked(self, object_id: ObjectID, entry: _Entry
+                        ) -> SerializedObject:
+        if entry.spilled_path is None:
+            raise ObjectLostError(object_id)
+        with open(entry.spilled_path, "rb") as f:
+            serialized = SerializedObject.from_bytes(f.read())
+        try:
+            os.unlink(entry.spilled_path)
+        except OSError:
+            pass
+        entry.serialized = serialized
+        entry.spilled_path = None
+        self._memory_used += entry.size
+        self._restored_bytes += entry.size
+        return serialized
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {
+                "num_objects": len(self._entries),
+                "memory_used_bytes": self._memory_used,
+                "spilled_bytes": self._spilled_bytes,
+                "restored_bytes": self._restored_bytes,
+            }

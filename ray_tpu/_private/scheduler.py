@@ -1,0 +1,335 @@
+"""Local task scheduler: dependency resolution, resource-aware dispatch,
+retries, lineage.
+
+Single-node rebuild of the reference's scheduling stack — the roles of
+NormalTaskSubmitter (owner-side submit), DependencyManager (wait for arg
+objects), LocalTaskManager (acquire resources + dispatch to a worker), and
+TaskManager (retries + lineage) (reference: src/ray/core_worker/transport/,
+src/ray/raylet/ [unverified]). The multi-node path reuses this per node
+behind the control plane in ray_tpu/_private/node.py; the compiled-graph
+path in ray_tpu/dag bypasses it entirely (SURVEY.md §2.3 north star).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.task_events import TaskEventBuffer
+from ray_tpu.exceptions import (
+    RayTaskError,
+    TaskCancelledError,
+)
+
+
+@dataclass
+class TaskSpec:
+    """Immutable description of a submitted task (TaskSpecification parity)."""
+
+    task_id: TaskID
+    function: Callable
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    num_returns: int
+    return_ids: List[ObjectID]
+    name: str = ""
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: Any = None
+    # Filled by the scheduler:
+    attempt: int = 0
+
+
+class ResourcePool:
+    """Node-local resource bookkeeping (CPU/TPU/custom, fractional allowed)."""
+
+    def __init__(self, total: Dict[str, float]):
+        self._total = dict(total)
+        self._available = dict(total)
+        self._cv = threading.Condition()
+
+    @property
+    def total(self) -> Dict[str, float]:
+        return dict(self._total)
+
+    def available(self) -> Dict[str, float]:
+        with self._cv:
+            return dict(self._available)
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(self._total.get(k, 0.0) >= v for k, v in demand.items())
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self._cv:
+            if all(self._available.get(k, 0.0) >= v - 1e-9
+                   for k, v in demand.items()):
+                for k, v in demand.items():
+                    self._available[k] = self._available.get(k, 0.0) - v
+                return True
+            return False
+
+    def release(self, demand: Dict[str, float]):
+        with self._cv:
+            for k, v in demand.items():
+                self._available[k] = self._available.get(k, 0.0) + v
+            self._cv.notify_all()
+
+    def wait_for_change(self, timeout: float = 0.5):
+        with self._cv:
+            self._cv.wait(timeout)
+
+    def utilization(self) -> float:
+        with self._cv:
+            fracs = [
+                1.0 - self._available.get(k, 0.0) / v
+                for k, v in self._total.items() if v > 0
+            ]
+            return max(fracs) if fracs else 0.0
+
+
+class LocalScheduler:
+    """Dependency-resolving, resource-aware FIFO dispatcher over a worker
+    thread pool, with retry + cancellation support."""
+
+    def __init__(self, store, resource_pool: ResourcePool, num_workers: int,
+                 task_events: Optional[TaskEventBuffer] = None,
+                 lineage: Optional[dict] = None):
+        self._store = store
+        self._resources = resource_pool
+        self._pool = ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix="ray_tpu_worker"
+        )
+        self._events = task_events
+        self._lineage = lineage if lineage is not None else {}
+        self._lock = threading.Lock()
+        self._runnable: List[TaskSpec] = []  # deps resolved, waiting on CPU
+        self._pending_deps: Dict[TaskID, int] = {}
+        self._cancelled: set = set()
+        self._running: Dict[TaskID, threading.Event] = {}
+        self._shutdown = False
+        self._backlog = 0
+        self._num_finished = 0
+        self._dispatch_cv = threading.Condition(self._lock)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="ray_tpu_dispatcher",
+        )
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------ submission
+    def submit(self, spec: TaskSpec):
+        """Owner-side submit: record lineage, wait for deps, then queue."""
+        if self._events:
+            self._events.record(spec.task_id, "PENDING_ARGS_AVAIL",
+                                name=spec.name)
+        self._lineage[spec.return_ids[0].task_id()] = spec
+        dep_refs = _collect_refs(spec.args, spec.kwargs)
+        with self._lock:
+            self._backlog += 1
+            if not dep_refs:
+                self._make_runnable_locked(spec)
+                return
+            self._pending_deps[spec.task_id] = len(dep_refs)
+
+        def _on_dep_ready():
+            with self._lock:
+                remaining = self._pending_deps.get(spec.task_id)
+                if remaining is None:
+                    return
+                remaining -= 1
+                if remaining == 0:
+                    del self._pending_deps[spec.task_id]
+                    self._make_runnable_locked(spec)
+                else:
+                    self._pending_deps[spec.task_id] = remaining
+
+        for ref in dep_refs:
+            self._store.on_ready(ref.object_id, _on_dep_ready)
+
+    def _make_runnable_locked(self, spec: TaskSpec):
+        self._runnable.append(spec)
+        if self._events:
+            self._events.record(spec.task_id, "PENDING_NODE_ASSIGNMENT",
+                                name=spec.name)
+        self._dispatch_cv.notify_all()
+
+    # -------------------------------------------------------------- dispatch
+    def _dispatch_loop(self):
+        while True:
+            with self._lock:
+                while not self._runnable and not self._shutdown:
+                    self._dispatch_cv.wait(0.2)
+                if self._shutdown:
+                    return
+                # FIFO scan for the first task whose resources fit now.
+                picked = None
+                for i, spec in enumerate(self._runnable):
+                    if self._resources.try_acquire(spec.resources):
+                        picked = self._runnable.pop(i)
+                        break
+            if picked is None:
+                self._resources.wait_for_change(0.05)
+                continue
+            self._pool.submit(self._execute, picked)
+
+    # ------------------------------------------------------------- execution
+    def _execute(self, spec: TaskSpec):
+        from ray_tpu._private import worker as worker_mod
+
+        cancelled_event = threading.Event()
+        with self._lock:
+            if spec.task_id in self._cancelled:
+                self._resources.release(spec.resources)
+                self._finish_cancelled(spec)
+                return
+            self._running[spec.task_id] = cancelled_event
+
+        if self._events:
+            self._events.record(spec.task_id, "RUNNING", name=spec.name)
+        start = time.monotonic()
+        try:
+            args, kwargs = _resolve_args(self._store, spec.args, spec.kwargs)
+            worker_mod._task_context.current_task_id = spec.task_id
+            worker_mod._task_context.task_name = spec.name
+            try:
+                result = spec.function(*args, **kwargs)
+            finally:
+                worker_mod._task_context.current_task_id = None
+                worker_mod._task_context.task_name = None
+            self._store_outputs(spec, result)
+            if self._events:
+                self._events.record(
+                    spec.task_id, "FINISHED", name=spec.name,
+                    duration=time.monotonic() - start)
+        except Exception as exc:  # noqa: BLE001 — task error boundary
+            self._handle_failure(spec, exc)
+        finally:
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+                self._backlog -= 1
+                self._num_finished += 1
+            self._resources.release(spec.resources)
+
+    def _store_outputs(self, spec: TaskSpec, result: Any):
+        from ray_tpu._private.worker import global_worker
+
+        ctx = global_worker().serialization_context
+        if spec.num_returns <= 1:
+            outputs = [result]
+        else:
+            outputs = list(result)
+            if len(outputs) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name!r} declared num_returns="
+                    f"{spec.num_returns} but returned {len(outputs)} values"
+                )
+        for oid, value in zip(spec.return_ids, outputs):
+            self._store.put(oid, ctx.serialize(value))
+
+    def _handle_failure(self, spec: TaskSpec, exc: Exception):
+        is_app_error = not isinstance(exc, (SystemError, MemoryError))
+        retriable = spec.attempt < spec.max_retries and (
+            spec.retry_exceptions or not is_app_error
+        )
+        cancelled = isinstance(exc, TaskCancelledError)
+        if self._events:
+            self._events.record(spec.task_id, "FAILED", name=spec.name)
+        if retriable and not cancelled:
+            retry = TaskSpec(
+                task_id=spec.task_id, function=spec.function, args=spec.args,
+                kwargs=spec.kwargs, num_returns=spec.num_returns,
+                return_ids=spec.return_ids, name=spec.name,
+                resources=spec.resources, max_retries=spec.max_retries,
+                retry_exceptions=spec.retry_exceptions,
+                scheduling_strategy=spec.scheduling_strategy,
+                attempt=spec.attempt + 1,
+            )
+            with self._lock:
+                self._backlog += 1
+                self._make_runnable_locked(retry)
+            return
+        if isinstance(exc, (TaskCancelledError, RayTaskError)):
+            error = exc  # pass dependency failures through unwrapped
+        else:
+            error = RayTaskError.from_exception(spec.name, exc)
+        for oid in spec.return_ids:
+            self._store.put_error(oid, error)
+
+    def _finish_cancelled(self, spec: TaskSpec):
+        err = TaskCancelledError(spec.task_id)
+        for oid in spec.return_ids:
+            self._store.put_error(oid, err)
+        with self._lock:
+            self._backlog -= 1
+
+    # ----------------------------------------------------------- cancel/misc
+    def cancel(self, task_id: TaskID):
+        with self._lock:
+            self._cancelled.add(task_id)
+            for i, spec in enumerate(self._runnable):
+                if spec.task_id == task_id:
+                    self._runnable.pop(i)
+                    threading.Thread(
+                        target=self._finish_cancelled, args=(spec,),
+                        daemon=True,
+                    ).start()
+                    return True
+            ev = self._running.get(task_id)
+            if ev is not None:
+                ev.set()  # cooperative: running tasks can poll was_cancelled
+                return False
+        # Not queued and not running: either not yet dep-resolved or done.
+        return False
+
+    def lineage_for(self, task_id: TaskID) -> Optional[TaskSpec]:
+        return self._lineage.get(task_id)
+
+    def backlog_size(self) -> int:
+        with self._lock:
+            return self._backlog
+
+    def num_finished(self) -> int:
+        with self._lock:
+            return self._num_finished
+
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            self._dispatch_cv.notify_all()
+        self._dispatcher.join(timeout=2)
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _collect_refs(args, kwargs) -> list:
+    """Top-level ObjectRef args are awaited + inlined (reference semantics:
+    nested refs inside structures are NOT resolved)."""
+    from ray_tpu._private.worker import ObjectRef
+
+    refs = [a for a in args if isinstance(a, ObjectRef)]
+    refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+    return refs
+
+
+def _resolve_args(store, args, kwargs):
+    from ray_tpu._private.worker import ObjectRef, global_worker
+
+    ctx = global_worker().serialization_context
+
+    def _resolve(v):
+        if isinstance(v, ObjectRef):
+            serialized = store.get(v.object_id)
+            value = ctx.deserialize(serialized)
+            if isinstance(value, RayTaskError):
+                raise value.as_instanceof_cause()
+            return value
+        return v
+
+    return (
+        tuple(_resolve(a) for a in args),
+        {k: _resolve(v) for k, v in kwargs.items()},
+    )

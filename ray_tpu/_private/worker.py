@@ -1,0 +1,358 @@
+"""Global worker: init/shutdown, ObjectRef, get/put/wait/cancel.
+
+Rebuild of the reference's worker core (reference:
+python/ray/_private/worker.py + the Cython CoreWorker it wraps [unverified]).
+One process-global ``Worker`` owns the serialization context, object store,
+local scheduler, actor registry, and task-event buffer; ``init()`` boots it
+and ``shutdown()`` tears it down. ObjectRefs count local references on
+construction/destruction (owner-side refcounting).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import tempfile
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import (
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+    _Counter,
+)
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.scheduler import LocalScheduler, ResourcePool, TaskSpec
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu._private.task_events import TaskEventBuffer
+from ray_tpu.exceptions import RayTaskError, RayTpuError
+
+_task_context = threading.local()
+
+
+class ObjectRef:
+    """Future handle to a task output or put object.
+
+    Pickling an ObjectRef registers the serialization with the owner store so
+    the object stays alive while borrowed (simplified borrower protocol).
+    """
+
+    __slots__ = ("object_id", "_owner", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, _add_ref: bool = True):
+        self.object_id = object_id
+        self._owner = _try_global_worker()
+        if _add_ref and self._owner is not None:
+            self._owner.store.add_local_ref(object_id)
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def task_id(self) -> TaskID:
+        return self.object_id.task_id()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        worker = global_worker()
+
+        def _done():
+            try:
+                fut.set_result(worker.get_object(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        worker.store.on_ready(self.object_id, _done)
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        afut = loop.create_future()
+        worker = global_worker()
+
+        def _done():
+            def _set():
+                if afut.cancelled():
+                    return
+                try:
+                    afut.set_result(worker.get_object(self))
+                except BaseException as e:  # noqa: BLE001
+                    afut.set_exception(e)
+
+            loop.call_soon_threadsafe(_set)
+
+        worker.store.on_ready(self.object_id, _done)
+        return afut.__await__()
+
+    def __reduce__(self):
+        w = _try_global_worker()
+        if w is not None:
+            # Borrowed: keep alive for the borrower's lifetime (simplified —
+            # the reference tracks borrowers and releases on their exit).
+            w.store.add_local_ref(self.object_id)
+        return (_deserialize_ref, (self.object_id,))
+
+    def __del__(self):
+        w = self._owner
+        if w is not None and w.is_alive:
+            try:
+                w.store.remove_local_ref(self.object_id)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+
+    def __hash__(self):
+        return hash(self.object_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __repr__(self):
+        return f"ObjectRef({self.object_id.hex()[:16]}…)"
+
+
+def _deserialize_ref(object_id: ObjectID) -> ObjectRef:
+    return ObjectRef(object_id, _add_ref=False)
+
+
+class Worker:
+    def __init__(self, num_cpus: Optional[int] = None,
+                 num_tpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 session_dir: Optional[str] = None):
+        self.is_alive = True
+        self.job_id = JobID.from_int(os.getpid() & 0xFFFFFFFF)
+        self.worker_id = WorkerID.from_random()
+        self.node_id = NodeID.from_random()
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        self.session_dir = session_dir or os.path.join(
+            tempfile.gettempdir(), "ray_tpu",
+            f"session_{uuid.uuid4().hex[:12]}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.serialization_context = SerializationContext()
+        spill_dir = GlobalConfig.object_spill_dir or os.path.join(
+            self.session_dir, "spill")
+        self.store = ObjectStore(spill_dir)
+        self.task_events = TaskEventBuffer(GlobalConfig.task_events_max_buffer)
+        if num_cpus is None:
+            num_cpus = os.cpu_count() or 1
+        total = {"CPU": float(num_cpus)}
+        if num_tpus is None:
+            try:
+                import jax
+
+                num_tpus = len([
+                    d for d in jax.devices() if d.platform != "cpu"
+                ])
+            except Exception:  # noqa: BLE001 — jax optional at init
+                num_tpus = 0
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.update(resources or {})
+        self.resource_pool = ResourcePool(total)
+        pool_size = GlobalConfig.worker_pool_size or max(int(num_cpus), 4)
+        self.scheduler = LocalScheduler(
+            self.store, self.resource_pool, pool_size,
+            task_events=self.task_events,
+        )
+        self.submission_counter = _Counter()
+        self.put_counter = _Counter()
+        self.actor_counter = _Counter()
+        self.actors: Dict[Any, Any] = {}  # ActorID -> _ActorRuntime
+        self.named_actors: Dict[str, Any] = {}  # (namespace,name) -> handle
+        self.placement_groups: Dict[Any, Any] = {}
+        self._kv: Dict[bytes, bytes] = {}  # internal KV (GCS-KV parity)
+        self._kv_lock = threading.Lock()
+
+    # ------------------------------------------------------------------- api
+    def current_task_id(self) -> TaskID:
+        tid = getattr(_task_context, "current_task_id", None)
+        return tid if tid is not None else self.driver_task_id
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.of(self.current_task_id(),
+                         self.submission_counter.next())
+
+    def put_object(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError(
+                "Calling put() on an ObjectRef is not allowed; pass the ref "
+                "directly instead.")
+        oid = ObjectID.for_put(self.current_task_id(),
+                               self.put_counter.next())
+        serialized = self.serialization_context.serialize(value)
+        self.store.put(oid, serialized)
+        return ObjectRef(oid)
+
+    def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
+        serialized = self.store.get(ref.object_id, timeout=timeout)
+        value = self.serialization_context.deserialize(serialized)
+        if isinstance(value, RayTaskError):
+            raise value.as_instanceof_cause()
+        return value
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        # Pin args that are refs for the duration of the task (submitted-refs
+        # in the reference's refcount protocol).
+        from ray_tpu._private.scheduler import _collect_refs
+
+        dep_refs = _collect_refs(spec.args, spec.kwargs)
+        for ref in dep_refs:
+            self.store.add_submitted_ref(ref.object_id)
+        refs = [ObjectRef(oid) for oid in spec.return_ids]
+        if dep_refs:
+            def _release(_refs=dep_refs):
+                for r in _refs:
+                    self.store.remove_submitted_ref(r.object_id)
+            self.store.on_ready(spec.return_ids[0], _release)
+        self.scheduler.submit(spec)
+        return refs
+
+    # -------------------------------------------------------- internal KV ---
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        with self._kv_lock:
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        with self._kv_lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: bytes) -> bool:
+        with self._kv_lock:
+            return self._kv.pop(key, None) is not None
+
+    def kv_keys(self, prefix: bytes = b"") -> List[bytes]:
+        with self._kv_lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    def shutdown(self):
+        self.is_alive = False
+        for actor in list(self.actors.values()):
+            try:
+                actor.terminate(no_restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+        self.actors.clear()
+        self.named_actors.clear()
+        self.scheduler.shutdown()
+
+
+_global_worker: Optional[Worker] = None
+_init_lock = threading.Lock()
+
+
+def _try_global_worker() -> Optional[Worker]:
+    return _global_worker
+
+
+def global_worker() -> Worker:
+    if _global_worker is None:
+        raise RayTpuError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first "
+            "(or use auto-init by calling a remote function)."
+        )
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         _system_config: Optional[Dict[str, Any]] = None,
+         ignore_reinit_error: bool = False, namespace: str = "default",
+         **_ignored) -> "Worker":
+    global _global_worker
+    with _init_lock:
+        if _global_worker is not None:
+            if ignore_reinit_error:
+                return _global_worker
+            raise RayTpuError(
+                "ray_tpu.init() called twice; pass ignore_reinit_error=True "
+                "to allow.")
+        if _system_config:
+            GlobalConfig.apply_system_config(_system_config)
+        _global_worker = Worker(num_cpus=num_cpus, num_tpus=num_tpus,
+                                resources=resources)
+        _global_worker.namespace = namespace
+        atexit.register(shutdown)
+        return _global_worker
+
+
+def shutdown():
+    global _global_worker
+    with _init_lock:
+        if _global_worker is None:
+            return
+        _global_worker.shutdown()
+        _global_worker = None
+
+
+def auto_init() -> Worker:
+    if _global_worker is None:
+        init(ignore_reinit_error=True)
+    return _global_worker
+
+
+# ------------------------------------------------------------ public verbs --
+def put(value: Any) -> ObjectRef:
+    return auto_init().put_object(value)
+
+
+def get(refs: Union[ObjectRef, List[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    worker = auto_init()
+    if isinstance(refs, ObjectRef):
+        return worker.get_object(refs, timeout=timeout)
+    if not isinstance(refs, list):
+        raise TypeError(
+            f"get() expects an ObjectRef or list of ObjectRefs, got "
+            f"{type(refs)}")
+    # One overall deadline across the whole list, not per ref.
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    out = []
+    for r in refs:
+        remaining = None
+        if deadline is not None:
+            remaining = max(deadline - _time.monotonic(), 0.0)
+        out.append(worker.get_object(r, timeout=remaining))
+    return out
+
+
+def wait(refs: List[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    worker = auto_init()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() expects a list of unique ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns ({num_returns}) exceeds number of refs "
+            f"({len(refs)})")
+    ready_ids, not_ready_ids = worker.store.wait(
+        [r.object_id for r in refs], num_returns, timeout)
+    by_id = {r.object_id: r for r in refs}
+    return ([by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids])
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    worker = global_worker()
+    task_id = ref.object_id.task_id()
+    removed = worker.scheduler.cancel(task_id)
+    if removed or force:
+        worker.store.cancel(ref.object_id, task_id)

@@ -1,0 +1,413 @@
+"""Actors: stateful workers with ordered method execution.
+
+Rebuild of the reference's actor surface (reference: python/ray/actor.py and
+the ActorTaskSubmitter/TaskReceiver ordering machinery [unverified]).
+``@remote`` on a class yields an ActorClass; ``.remote()`` creates an actor
+backed by a dedicated execution loop (one thread for sync actors, an asyncio
+event loop for async actors, a thread pool for ``max_concurrency > 1``);
+method calls are submitted in order per caller and return ObjectRefs.
+``max_restarts`` restarts a killed actor with fresh state; named actors are
+resolvable via ``get_actor``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private.worker import ObjectRef, auto_init, global_worker
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    RayActorError,
+    RayTaskError,
+    TaskCancelledError,
+)
+
+_TERMINATE = object()
+
+
+class _MethodCall:
+    __slots__ = ("method_name", "args", "kwargs", "return_ids", "name",
+                 "cancelled")
+
+    def __init__(self, method_name, args, kwargs, return_ids, name):
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.return_ids = return_ids
+        self.name = name
+        self.cancelled = False
+
+
+class _ActorRuntime:
+    """Execution loop + mailbox for one actor instance."""
+
+    def __init__(self, actor_id: ActorID, cls: type, init_args, init_kwargs,
+                 *, max_concurrency: int, max_restarts: int, name: str,
+                 actor_name: Optional[str]):
+        self.actor_id = actor_id
+        self.cls = cls
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.max_restarts = max_restarts
+        self.restarts_used = 0
+        self.class_name = name
+        self.actor_name = actor_name
+        self.dead = False
+        self.death_cause: Optional[str] = None
+        self._mailbox: "queue.Queue" = queue.Queue()
+        self._seq_counter = 0
+        self._lock = threading.Lock()
+        self.is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, inspect.isfunction)
+        )
+        # Default concurrency: async actors interleave up to 1000 coroutines
+        # (reference default); sync actors are single-threaded unless asked.
+        if max_concurrency is None:
+            max_concurrency = 1000 if self.is_async else 1
+        self.max_concurrency = max(int(max_concurrency), 1)
+        self._start_loop()
+
+    # ---------------------------------------------------------------- loops
+    def _start_loop(self):
+        self._instance_ready = threading.Event()
+        self._init_error: Optional[BaseException] = None
+        mailbox = self._mailbox
+        target = self._run_async if self.is_async else self._run_sync
+        self._thread = threading.Thread(
+            target=target, args=(mailbox,),
+            daemon=True, name=f"actor-{self.class_name}",
+        )
+        self._thread.start()
+
+    def _construct(self):
+        try:
+            self.instance = self.cls(*self.init_args, **self.init_kwargs)
+            self._init_error = None
+        except BaseException as e:  # noqa: BLE001 — init error boundary
+            self._init_error = e
+            self.dead = True
+            self.death_cause = f"__init__ failed: {e!r}"
+        finally:
+            self._instance_ready.set()
+
+    def _run_sync(self, mailbox):
+        self._construct()
+        worker = global_worker()
+        if self._init_error is not None:
+            self._drain_with_error(mailbox)
+            return
+        if self.max_concurrency > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=self.max_concurrency)
+            while True:
+                call = mailbox.get()
+                if call is _TERMINATE:
+                    pool.shutdown(wait=False)
+                    return
+                pool.submit(self._execute_call, worker, call)
+        else:
+            while True:
+                call = mailbox.get()
+                if call is _TERMINATE:
+                    return
+                self._execute_call(worker, call)
+
+    def _run_async(self, mailbox):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._construct()
+        worker = global_worker()
+        if self._init_error is not None:
+            self._drain_with_error(mailbox)
+            return
+
+        async def _main():
+            sem = asyncio.Semaphore(self.max_concurrency)
+            while True:
+                call = await loop.run_in_executor(None, mailbox.get)
+                if call is _TERMINATE:
+                    return
+                await sem.acquire()
+
+                async def _run(call=call):
+                    try:
+                        await self._execute_call_async(worker, call)
+                    finally:
+                        sem.release()
+
+                loop.create_task(_run())
+
+        loop.run_until_complete(_main())
+        loop.close()
+
+    # ------------------------------------------------------------ execution
+    def _execute_call(self, worker, call: _MethodCall):
+        if call.cancelled:
+            self._fail_call(worker, call, TaskCancelledError())
+            return
+        worker.task_events.record(
+            call.return_ids[0].task_id(), "RUNNING", name=call.name)
+        try:
+            method = getattr(self.instance, call.method_name)
+            args, kwargs = _resolve_actor_args(worker, call)
+            result = method(*args, **kwargs)
+            self._store_outputs(worker, call, result)
+            worker.task_events.record(
+                call.return_ids[0].task_id(), "FINISHED", name=call.name)
+        except BaseException as exc:  # noqa: BLE001 — method error boundary
+            self._fail_call(
+                worker, call, RayTaskError.from_exception(call.name, exc))
+            worker.task_events.record(
+                call.return_ids[0].task_id(), "FAILED", name=call.name)
+
+    async def _execute_call_async(self, worker, call: _MethodCall):
+        if call.cancelled:
+            self._fail_call(worker, call, TaskCancelledError())
+            return
+        try:
+            method = getattr(self.instance, call.method_name)
+            args, kwargs = _resolve_actor_args(worker, call)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self._store_outputs(worker, call, result)
+        except BaseException as exc:  # noqa: BLE001
+            self._fail_call(
+                worker, call, RayTaskError.from_exception(call.name, exc))
+
+    def _store_outputs(self, worker, call: _MethodCall, result):
+        ctx = worker.serialization_context
+        if len(call.return_ids) == 1:
+            outputs = [result]
+        else:
+            outputs = list(result)
+            if len(outputs) != len(call.return_ids):
+                raise ValueError(
+                    f"method {call.name!r} declared num_returns="
+                    f"{len(call.return_ids)} but returned {len(outputs)} "
+                    f"values")
+        for oid, value in zip(call.return_ids, outputs):
+            worker.store.put(oid, ctx.serialize(value))
+
+    def _fail_call(self, worker, call: _MethodCall, error: BaseException):
+        for oid in call.return_ids:
+            worker.store.put_error(oid, error)
+
+    def _drain_with_error(self, mailbox):
+        worker = global_worker()
+        err = ActorDiedError(self.actor_id, self.death_cause or "actor died")
+        while True:
+            try:
+                call = mailbox.get(timeout=0.5)
+            except queue.Empty:
+                if self.dead:
+                    return
+                continue
+            if call is _TERMINATE:
+                return
+            self._fail_call(worker, call, err)
+
+    # ------------------------------------------------------------ submission
+    def submit(self, method_name: str, args, kwargs, num_returns: int,
+               name: str):
+        worker = global_worker()
+        with self._lock:
+            self._seq_counter += 1
+            task_id = TaskID.for_actor_task(self.actor_id, self._seq_counter)
+        return_ids = [
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        ]
+        refs = [ObjectRef(oid) for oid in return_ids]
+        if self.dead:
+            err = ActorDiedError(self.actor_id,
+                                 self.death_cause or "actor is dead")
+            for oid in return_ids:
+                worker.store.put_error(oid, err)
+            return refs
+        worker.task_events.record(task_id, "PENDING_ACTOR_TASK", name=name)
+        call = _MethodCall(method_name, args, kwargs, return_ids, name)
+        with self._lock:
+            self._mailbox.put(call)
+        return refs
+
+    # ------------------------------------------------------------- lifecycle
+    def terminate(self, no_restart: bool = True):
+        if self.dead and no_restart:
+            return
+        with self._lock:
+            if not no_restart and self.restarts_used < self.max_restarts:
+                self.restarts_used += 1
+                # Fresh mailbox for the restarted loop; the old loop drains
+                # its own mailbox and exits on the _TERMINATE sentinel.
+                old_mailbox = self._mailbox
+                self._mailbox = queue.Queue()
+                old_mailbox.put(_TERMINATE)
+                self._start_loop()  # fresh state
+                return
+            self.dead = True
+            self.death_cause = "killed via ray_tpu.kill()"
+            self._mailbox.put(_TERMINATE)
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+
+def _resolve_actor_args(worker, call: _MethodCall):
+    def _resolve(v):
+        if isinstance(v, ObjectRef):
+            value = worker.get_object(v)
+            return value
+        return v
+
+    return (
+        tuple(_resolve(a) for a in call.args),
+        {k: _resolve(v) for k, v in call.kwargs.items()},
+    )
+
+
+class ActorMethod:
+    def __init__(self, runtime: _ActorRuntime, method_name: str,
+                 options: Dict[str, Any]):
+        self._runtime = runtime
+        self._method_name = method_name
+        self._options = options
+
+    def options(self, **opts) -> "ActorMethod":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorMethod(self._runtime, self._method_name, merged)
+
+    def remote(self, *args, **kwargs):
+        num_returns = self._options.get("num_returns", 1)
+        name = self._options.get(
+            "name",
+            f"{self._runtime.class_name}.{self._method_name}")
+        refs = self._runtime.submit(
+            self._method_name, args, kwargs, num_returns, name)
+        return refs[0] if num_returns == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; "
+            f"use .remote().")
+
+
+class ActorHandle:
+    def __init__(self, runtime: _ActorRuntime):
+        self._runtime = runtime
+        self._actor_id = runtime.actor_id
+
+    @property
+    def _ray_actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        method_opts = {}
+        fn = getattr(self._runtime.cls, item, None)
+        if fn is None:
+            raise AttributeError(
+                f"actor {self._runtime.class_name!r} has no method {item!r}")
+        method_opts = getattr(fn, "__ray_tpu_method_options__", {})
+        return ActorMethod(self._runtime, item, dict(method_opts))
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id,))
+
+    def __repr__(self):
+        return (f"ActorHandle({self._runtime.class_name}, "
+                f"{self._actor_id.hex()[:12]}…)")
+
+
+def _rebuild_handle(actor_id: ActorID) -> ActorHandle:
+    worker = global_worker()
+    runtime = worker.actors.get(actor_id)
+    if runtime is None:
+        raise RayActorError(actor_id, "actor not found on this node")
+    return ActorHandle(runtime)
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = options
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = auto_init()
+        opts = self._options
+        actor_name = opts.get("name")
+        namespace = opts.get("namespace",
+                             getattr(worker, "namespace", "default"))
+        if actor_name:
+            key = (namespace, actor_name)
+            existing = worker.named_actors.get(key)
+            if existing is not None and not existing._runtime.dead:
+                if opts.get("get_if_exists"):
+                    return existing
+                raise ValueError(
+                    f"actor name {actor_name!r} already taken in namespace "
+                    f"{namespace!r}")
+        actor_id = ActorID.of(
+            worker.job_id, worker.current_task_id(),
+            worker.actor_counter.next())
+        max_restarts = opts.get("max_restarts")
+        if max_restarts is None:
+            max_restarts = GlobalConfig.actor_max_restarts
+        max_concurrency = opts.get("max_concurrency")
+        runtime = _ActorRuntime(
+            actor_id, self._cls, args, kwargs,
+            max_concurrency=max_concurrency,
+            max_restarts=max_restarts,
+            name=self._cls.__name__,
+            actor_name=actor_name,
+        )
+        worker.actors[actor_id] = runtime
+        handle = ActorHandle(runtime)
+        if actor_name:
+            worker.named_actors[(namespace, actor_name)] = handle
+        return handle
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag.dag_node import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote().")
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    worker = global_worker()
+    ns = namespace or getattr(worker, "namespace", "default")
+    handle = worker.named_actors.get((ns, name))
+    if handle is None or handle._runtime.dead:
+        raise ValueError(
+            f"no live actor named {name!r} in namespace {ns!r}")
+    return handle
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError(f"kill() expects an ActorHandle, got {type(actor)}")
+    actor._runtime.terminate(no_restart=no_restart)

@@ -1,0 +1,126 @@
+"""Typed error surface.
+
+Mirrors the reference's exception taxonomy (reference:
+python/ray/exceptions.py [unverified]) so users migrating from it find the
+same failure vocabulary: remote task errors carry the reconstructed remote
+traceback; object loss / worker death / timeouts are distinct types.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised an exception remotely; re-raised at `get`.
+
+    Holds the original exception class, message, and remote traceback, and
+    re-raises as a subclass of the original type where possible so user
+    ``except`` clauses still match.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Optional[BaseException] = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"task {function_name!r} failed:\n{traceback_str}"
+        )
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException):
+        tb = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)
+        )
+        return cls(function_name, tb, cause=exc)
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Return an exception that is `isinstance` of the original type."""
+        if self.cause is None:
+            return self
+        cause_cls = type(self.cause)
+        if cause_cls is RayTaskError or issubclass(cause_cls, RayTpuError):
+            return self
+        try:
+            derived = type(
+                "RayTaskError(" + cause_cls.__name__ + ")",
+                (RayTaskError, cause_cls),
+                {"__init__": lambda s: None},
+            )()
+            derived.function_name = self.function_name
+            derived.traceback_str = self.traceback_str
+            derived.cause = self.cause
+            derived.args = (str(self),)
+            return derived
+        except TypeError:
+            return self
+
+
+class RayActorError(RayTpuError):
+    """The actor died before or while executing the task."""
+
+    def __init__(self, actor_id=None, message: str = ""):
+        self.actor_id = actor_id
+        super().__init__(message or f"actor {actor_id} is dead")
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """Actor is temporarily unreachable (restarting)."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} was cancelled")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_ref=None, message: str = ""):
+        self.object_ref = object_ref
+        super().__init__(message or f"object {object_ref} was lost")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceededError(RayTpuError):
+    pass
+
+
+class ChannelError(RayTpuError):
+    """Compiled-graph channel failure (closed, timeout, version skew)."""
+
+
+class ChannelTimeoutError(ChannelError, TimeoutError):
+    pass

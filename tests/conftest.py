@@ -1,0 +1,40 @@
+"""Test fixtures.
+
+Mirrors the reference's conftest strategy (SURVEY.md §4): distributed paths
+run without real hardware — here an 8-device virtual CPU mesh via
+``xla_force_host_platform_device_count`` stands in for a TPU slice, and the
+``ray_start_regular`` fixture boots/tears down a fresh local runtime per test.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield worker
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, (
+        "tests require XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    yield devices[:8]

@@ -1,0 +1,219 @@
+"""Core task API semantics (reference: python/ray/tests/test_basic.py role)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    RayTaskError,
+    TaskCancelledError,
+)
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_numpy_zero_copy(ray_start_regular):
+    arr = np.arange(1 << 16, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_chain(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(50):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 50
+
+
+def test_fan_out_fan_in(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        ray_tpu.get(boom.remote())
+    # Also matches the framework type.
+    with pytest.raises(RayTaskError):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_chain(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise KeyError("inner")
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    with pytest.raises(KeyError):
+        ray_tpu.get(passthrough.remote(boom.remote()))
+
+
+def test_retries(ray_start_regular):
+    state = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert state["n"] == 3
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+    ray_tpu.cancel(s, force=True)
+
+
+def test_wait_validates_args(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.wait(ref)
+    with pytest.raises(ValueError):
+        ray_tpu.wait([ref, ref])
+    with pytest.raises(ValueError):
+        ray_tpu.wait([ref], num_returns=2)
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    ref = slow.remote()
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.2)
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_cancel_queued_task(ray_start_regular):
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(1)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def victim():
+        return "ran"
+
+    h = hog.remote()
+    v = victim.remote()  # queued behind the hog (both need all 4 CPUs)
+    ray_tpu.cancel(v, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=10)
+    assert ray_tpu.get(h) == "hog"
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return ray_tpu.get_runtime_context().get_task_name()
+
+    assert ray_tpu.get(f.options(name="custom_name").remote()) == "custom_name"
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_resources_accounting(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+
+    @ray_tpu.remote(num_cpus=2)
+    def probe():
+        return ray_tpu.available_resources()["CPU"]
+
+    assert ray_tpu.get(probe.remote()) <= 2.0
+
+
+def test_put_objectref_rejected(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_object_ref_in_container_not_resolved(ray_start_regular):
+    @ray_tpu.remote
+    def f(d):
+        return d["ref"]
+
+    ref = ray_tpu.put(7)
+    out = ray_tpu.get(f.remote({"ref": ref}))
+    assert isinstance(out, ray_tpu.ObjectRef)
+    assert ray_tpu.get(out) == 7
+
+
+def test_refcount_eviction(ray_start_regular, ray_start_regular_worker=None):
+    worker = ray_start_regular
+    ref = ray_tpu.put(np.zeros(1000))
+    oid = ref.object_id
+    assert worker.store.contains(oid)
+    del ref
+    import gc
+
+    gc.collect()
+    assert not worker.store.contains(oid)
