@@ -192,6 +192,7 @@ class LocalScheduler:
         if self._events:
             self._events.record(spec.task_id, "RUNNING", name=spec.name)
         start = time.monotonic()
+        retry_spec = None
         try:
             args, kwargs = _resolve_args(self._store, spec.args, spec.kwargs)
             worker_mod._task_context.current_task_id = spec.task_id
@@ -207,13 +208,19 @@ class LocalScheduler:
                     spec.task_id, "FINISHED", name=spec.name,
                     duration=time.monotonic() - start)
         except Exception as exc:  # noqa: BLE001 — task error boundary
-            self._handle_failure(spec, exc)
+            retry_spec = self._handle_failure(spec, exc)
         finally:
             with self._lock:
                 self._running.pop(spec.task_id, None)
                 self._backlog -= 1
                 self._num_finished += 1
             self._resources.release(spec.resources)
+            # Enqueue the retry only after this attempt's bookkeeping is
+            # gone, so the retry's _running entry can't be popped by us.
+            if retry_spec is not None:
+                with self._lock:
+                    self._backlog += 1
+                    self._make_runnable_locked(retry_spec)
 
     def _store_outputs(self, spec: TaskSpec, result: Any):
         from ray_tpu._private.worker import global_worker
@@ -240,7 +247,7 @@ class LocalScheduler:
         if self._events:
             self._events.record(spec.task_id, "FAILED", name=spec.name)
         if retriable and not cancelled:
-            retry = TaskSpec(
+            return TaskSpec(
                 task_id=spec.task_id, function=spec.function, args=spec.args,
                 kwargs=spec.kwargs, num_returns=spec.num_returns,
                 return_ids=spec.return_ids, name=spec.name,
@@ -249,10 +256,6 @@ class LocalScheduler:
                 scheduling_strategy=spec.scheduling_strategy,
                 attempt=spec.attempt + 1,
             )
-            with self._lock:
-                self._backlog += 1
-                self._make_runnable_locked(retry)
-            return
         if isinstance(exc, (TaskCancelledError, RayTaskError)):
             error = exc  # pass dependency failures through unwrapped
         else:

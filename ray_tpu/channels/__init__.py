@@ -1,0 +1,24 @@
+"""Typed single-writer/multi-reader channels for compiled graphs.
+
+Rebuild of the reference's channel layer (reference:
+python/ray/experimental/channel/ [unverified]): fixed-buffer, versioned
+pipes between compiled-graph stages. In-process channels use a mutable
+slot + condition variable; cross-process channels ride the native
+shared-memory store (ray_tpu/_native); device-to-device edges inside a
+compiled JAX program need no channel at all — they are HBM buffers wired by
+XLA (the TorchTensorNcclChannel analogue is an ICI edge, not an object).
+"""
+
+from ray_tpu.channels.channel import (
+    BufferedChannel,
+    Channel,
+    CompositeChannel,
+    IntraProcessChannel,
+)
+
+__all__ = [
+    "BufferedChannel",
+    "Channel",
+    "CompositeChannel",
+    "IntraProcessChannel",
+]

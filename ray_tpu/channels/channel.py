@@ -1,0 +1,143 @@
+"""Channel implementations.
+
+Semantics mirror the reference's mutable-object channels (reference:
+python/ray/experimental/channel/shared_memory_channel.py [unverified]):
+a write blocks until all readers of the previous version have consumed it
+(single outstanding version), each reader sees each version exactly once,
+and close() unblocks everyone with ChannelError.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu.exceptions import ChannelError, ChannelTimeoutError
+
+
+class Channel:
+    """Abstract single-writer multi-reader channel."""
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def read(self, reader_id: int = 0, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def close(self):
+        raise NotImplementedError
+
+
+class IntraProcessChannel(Channel):
+    """Versioned single-slot channel: the mutable-object fast path.
+
+    One buffer, a version counter, and per-reader consumed versions — the
+    same protocol the reference implements over plasma mutable objects,
+    here over a condition variable (cross-process variant in _native).
+    """
+
+    def __init__(self, num_readers: int = 1):
+        if num_readers < 1:
+            raise ValueError("num_readers must be >= 1")
+        self._num_readers = num_readers
+        self._cv = threading.Condition()
+        self._value: Any = None
+        self._version = 0
+        self._reads_left = 0  # readers yet to consume current version
+        self._read_version = [0] * num_readers
+        self._closed = False
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        timeout = (GlobalConfig.channel_read_timeout_s
+                   if timeout is None else timeout)
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._reads_left > 0 and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ChannelTimeoutError(
+                        "write blocked: readers have not consumed the "
+                        "previous version")
+                self._cv.wait(remaining)
+            if self._closed:
+                raise ChannelError("channel is closed")
+            self._value = value
+            self._version += 1
+            self._reads_left = self._num_readers
+            self._cv.notify_all()
+
+    def read(self, reader_id: int = 0, timeout: Optional[float] = None):
+        timeout = (GlobalConfig.channel_read_timeout_s
+                   if timeout is None else timeout)
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._read_version[reader_id] >= self._version
+                   and not self._closed):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ChannelTimeoutError("read timed out")
+                self._cv.wait(remaining)
+            if self._closed and self._read_version[reader_id] >= self._version:
+                raise ChannelError("channel is closed")
+            self._read_version[reader_id] = self._version
+            value = self._value
+            self._reads_left -= 1
+            if self._reads_left == 0:
+                self._cv.notify_all()
+            return value
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class BufferedChannel(Channel):
+    """Ring of K versioned slots so the writer can run K versions ahead
+    (BufferedSharedMemoryChannel parity — max buffered executions)."""
+
+    def __init__(self, num_readers: int = 1, buffer_count: int = 2):
+        self._slots: List[IntraProcessChannel] = [
+            IntraProcessChannel(num_readers) for _ in range(buffer_count)
+        ]
+        self._w = 0
+        self._r = [0] * num_readers
+        self._lock = threading.Lock()
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        with self._lock:
+            slot = self._slots[self._w % len(self._slots)]
+            self._w += 1
+        slot.write(value, timeout)
+
+    def read(self, reader_id: int = 0, timeout: Optional[float] = None):
+        with self._lock:
+            slot = self._slots[self._r[reader_id] % len(self._slots)]
+            self._r[reader_id] += 1
+        return slot.read(reader_id, timeout)
+
+    def close(self):
+        for s in self._slots:
+            s.close()
+
+
+class CompositeChannel(Channel):
+    """Fans one writer out to several underlying channels (the reference
+    uses this to split local vs remote readers)."""
+
+    def __init__(self, channels: List[Channel]):
+        self._channels = channels
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        for ch in self._channels:
+            ch.write(value, timeout)
+
+    def read(self, reader_id: int = 0, timeout: Optional[float] = None):
+        raise TypeError(
+            "read from the component channel, not the composite")
+
+    def close(self):
+        for ch in self._channels:
+            ch.close()

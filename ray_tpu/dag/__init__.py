@@ -1,0 +1,34 @@
+"""DAG authoring + compiled execution (interpreted, actor-loop, JAX).
+
+See dag_node.py (authoring), compiled_dag.py (actor-loop backend), and
+jax_executor.py (the TPU-resident wave executor — the north star).
+"""
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    reduce_tree,
+)
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.jax_executor import CompiledJaxDAG, JaxDAGRef, compile_jax_dag
+
+__all__ = [
+    "ClassMethodNode",
+    "ClassNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "CompiledJaxDAG",
+    "DAGNode",
+    "FunctionNode",
+    "InputAttributeNode",
+    "InputNode",
+    "JaxDAGRef",
+    "MultiOutputNode",
+    "compile_jax_dag",
+    "reduce_tree",
+]

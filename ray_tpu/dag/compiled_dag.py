@@ -1,0 +1,251 @@
+"""Actor-loop compiled DAG backend (reference aDAG semantics).
+
+Rebuild of the reference's CompiledDAG (reference:
+python/ray/dag/compiled_dag_node.py [unverified]): compiling a DAG allocates
+versioned channels on every edge and starts one long-running execution loop
+per participating actor that repeatedly reads its input channels, runs the
+bound method, and writes its output channel — no per-execution scheduling.
+``execute()`` writes the input channel and returns a ref; ``get()`` reads
+the output channel. This is the host-side path for arbitrary Python stages;
+jax-traceable pure-task DAGs should use backend="jax" (jax_executor.py),
+which fuses the whole graph into one XLA program instead.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.channels import BufferedChannel
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.exceptions import ChannelError, RayTaskError
+
+_UNREAD = object()
+
+
+class CompiledDAGRef:
+    """Handle to one in-flight execution; results must be read in order."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+        self._value: Any = None
+        self._resolved = False
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._read_result(self._index, timeout)
+
+
+class _Stage:
+    """One executable node: read args from channels, run, write output."""
+
+    def __init__(self, node: DAGNode, fn, arg_sources: List[Tuple],
+                 out_channel: BufferedChannel):
+        self.node = node
+        self.fn = fn
+        self.arg_sources = arg_sources  # (channel, reader_id) or ("const", v)
+        self.out_channel = out_channel
+
+    def run_once(self):
+        args = []
+        for kind, a, b in self.arg_sources:
+            if kind == "const":
+                args.append(a)
+            else:
+                args.append(a.read(b))
+        try:
+            value = self.fn(*args)
+        except Exception as exc:  # noqa: BLE001 — stage error boundary
+            value = RayTaskError.from_exception(
+                getattr(self.fn, "__name__", "stage"), exc)
+        self.out_channel.write(value)
+
+
+class CompiledDAG:
+    def __init__(self, leaf: DAGNode, max_buffered_executions: int = 2,
+                 **_options):
+        self._leaf = leaf
+        self._buffer = max(int(max_buffered_executions), 1)
+        self._lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        self._exec_count = 0
+        self._read_count = 0
+        self._results: Dict[int, Any] = {}
+        self._torn_down = False
+        self._build()
+        self._partial = [_UNREAD] * len(self._out_sources)
+
+    # ------------------------------------------------------------------ build
+    def _build(self):
+        order = self._leaf.topological_order()
+        self._input_node: Optional[InputNode] = None
+        consumers: Dict[int, int] = {}  # id(node) -> number of consumers
+
+        exec_nodes: List[DAGNode] = []
+        for node in order:
+            if isinstance(node, InputNode):
+                if self._input_node is not None and node is not self._input_node:
+                    raise ValueError("compiled DAG supports one InputNode")
+                self._input_node = node
+            elif isinstance(node, (FunctionNode, ClassMethodNode,
+                                   InputAttributeNode)):
+                exec_nodes.append(node)
+            elif isinstance(node, MultiOutputNode):
+                if node is not self._leaf:
+                    raise ValueError("MultiOutputNode must be the leaf")
+            elif isinstance(node, ClassNode):
+                pass  # actor construction resolved below
+            else:
+                raise TypeError(
+                    f"cannot compile node type {type(node).__name__}")
+
+        def _count_consumer(dep: DAGNode):
+            consumers[id(dep)] = consumers.get(id(dep), 0) + 1
+
+        for node in exec_nodes:
+            for a in list(node._bound_args) + list(
+                    node._bound_kwargs.values()):
+                if isinstance(a, DAGNode) and not isinstance(a, ClassNode):
+                    _count_consumer(a)
+        if isinstance(self._leaf, MultiOutputNode):
+            for a in self._leaf._bound_args:
+                _count_consumer(a)
+        else:
+            _count_consumer(self._leaf)
+
+        # Channels per node output (input node included).
+        self._channels: Dict[int, BufferedChannel] = {}
+        reader_cursor: Dict[int, int] = {}
+        for node in order:
+            n = consumers.get(id(node), 0)
+            if n > 0 and not isinstance(node, (MultiOutputNode, ClassNode)):
+                self._channels[id(node)] = BufferedChannel(
+                    num_readers=n, buffer_count=self._buffer)
+                reader_cursor[id(node)] = 0
+
+        def _source_for(a):
+            if isinstance(a, ClassNode):
+                raise ValueError("actor handles cannot be data deps")
+            if isinstance(a, DAGNode):
+                ch = self._channels[id(a)]
+                rid = reader_cursor[id(a)]
+                reader_cursor[id(a)] += 1
+                return ("chan", ch, rid)
+            return ("const", a, None)
+
+        # Build stages grouped by execution loop: one loop per actor, one
+        # driver-side loop for stateless/projection stages.
+        self._loops: Dict[Any, List[_Stage]] = {}
+        for node in exec_nodes:
+            if node._bound_kwargs:
+                raise ValueError(
+                    "compiled DAGs require positional bind() args")
+            arg_sources = [_source_for(a) for a in node._bound_args]
+            out_ch = self._channels.get(id(node))
+            if out_ch is None:
+                # Leaf with no consumers shouldn't happen (leaf counted).
+                out_ch = BufferedChannel(1, self._buffer)
+            if isinstance(node, FunctionNode):
+                fn = node.function
+                key = "__driver__"
+            elif isinstance(node, InputAttributeNode):
+                k = node._key
+
+                def fn(v, _k=k):
+                    if isinstance(_k, str) and not isinstance(v, dict):
+                        return getattr(v, _k)
+                    return v[_k]
+
+                key = "__driver__"
+            else:  # ClassMethodNode
+                method = node._bound_method()
+                runtime = method._runtime
+                if runtime._instance_ready is not None:
+                    runtime._instance_ready.wait(timeout=30)
+                instance = runtime.instance
+                fn = getattr(instance, method._method_name)
+                key = runtime.actor_id
+            self._loops.setdefault(key, []).append(
+                _Stage(node, fn, arg_sources, out_ch))
+
+        # Output readers (driver side).
+        if isinstance(self._leaf, MultiOutputNode):
+            self._out_sources = [
+                _source_for(a) for a in self._leaf._bound_args]
+            self._multi_output = True
+        else:
+            self._out_sources = [_source_for(self._leaf)]
+            self._multi_output = False
+
+        # Start loop threads: each iterates its stages in topo order.
+        self._threads: List[threading.Thread] = []
+        for key, stages in self._loops.items():
+            t = threading.Thread(
+                target=self._exec_loop, args=(stages,), daemon=True,
+                name=f"compiled-dag-loop-{key}")
+            t.start()
+            self._threads.append(t)
+
+    def _exec_loop(self, stages: List[_Stage]):
+        """do_exec_tasks parity: run the static schedule until teardown."""
+        while True:
+            try:
+                for stage in stages:
+                    stage.run_once()
+            except ChannelError:
+                return
+
+    # ---------------------------------------------------------------- execute
+    def execute(self, *input_values) -> CompiledDAGRef:
+        if self._torn_down:
+            raise ChannelError("compiled DAG has been torn down")
+        # Index assignment and input write are atomic so concurrent
+        # execute() calls keep ref<->result order aligned.
+        with self._lock:
+            index = self._exec_count
+            self._exec_count += 1
+            if self._input_node is not None:
+                ch = self._channels.get(id(self._input_node))
+                if ch is not None:
+                    value = (input_values[0] if len(input_values) == 1
+                             else input_values)
+                    ch.write(value)
+        return CompiledDAGRef(self, index)
+
+    def _read_result(self, index: int, timeout: Optional[float]):
+        with self._read_lock:
+            while self._read_count <= index:
+                # Partial reads survive a timeout: each output channel is
+                # consumed at most once per execution row, so a retry after
+                # ChannelTimeoutError resumes at the missing channel instead
+                # of desyncing reader cursors across executions.
+                for i, (kind, ch, rid) in enumerate(self._out_sources):
+                    if self._partial[i] is _UNREAD:
+                        self._partial[i] = (
+                            ch.read(rid, timeout) if kind == "chan" else ch)
+                vals, self._partial = (
+                    self._partial, [_UNREAD] * len(self._out_sources))
+                result = vals if self._multi_output else vals[0]
+                self._results[self._read_count] = result
+                self._read_count += 1
+            result = self._results.pop(index)
+        errs = result if isinstance(result, list) else [result]
+        for v in errs:
+            if isinstance(v, RayTaskError):
+                raise v.as_instanceof_cause()
+        return result
+
+    def teardown(self):
+        self._torn_down = True
+        for ch in self._channels.values():
+            ch.close()
+        for t in self._threads:
+            t.join(timeout=2)

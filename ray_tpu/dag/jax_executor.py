@@ -1,0 +1,482 @@
+"""The TPU-resident DAG executor: lower a static task DAG to one JAX program.
+
+This is the BASELINE.json north star. Where the reference routes every task
+through owner→raylet lease loops and per-actor execution loops over plasma
+mutable objects (reference: python/ray/dag/compiled_dag_node.py +
+src/ray/raylet scheduling stack [unverified]), this executor compiles the
+whole DAG into a single XLA program:
+
+- **Object table**: all intermediate values live in one HBM-resident array
+  ``obj[num_slots, *payload_shape]`` — the plasma analogue is a buffer pool
+  indexed by object slot, never leaving the device.
+- **Task table**: per-task op index, padded argument slots, and output slot
+  as int32 arrays — the TaskSpec analogue.
+- **Static wave schedule** (default): dependency levels are resolved at
+  compile time into a ``[num_waves, wave_width]`` schedule; execution is a
+  ``lax.fori_loop`` over waves whose body gathers args
+  (``obj[arg_slots]``), runs every task in the wave via a vmapped
+  ``lax.switch`` over the op table, and scatters outputs — argument
+  gather/scatter as batched sparse ops, exactly the north-star phrasing.
+- **Dynamic frontier mode** (``dynamic=True``): a ``lax.while_loop`` keeps
+  an in-degree vector on device; each iteration executes the ready frontier
+  (``indeg == 0 & ~done``) masked across all tasks and decrements consumer
+  in-degrees with a segment-sum over the edge list — ObjectRef dependency
+  resolution as sparse ops, no host round-trips per wave.
+
+Multi-chip: the object table can be sharded over a Mesh axis; cross-shard
+edges then lower to XLA collectives on ICI (see ray_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+class JaxDAGRef:
+    """CompiledDAGRef analogue: handle to a completed on-device execution."""
+
+    def __init__(self, arrays, multi: bool):
+        self._arrays = arrays
+        self._multi = multi
+
+    def get(self):
+        if self._multi:
+            return [np.asarray(a) for a in self._arrays]
+        return np.asarray(self._arrays)
+
+    def device_value(self):
+        """The raw jax array(s), still on device (no host transfer)."""
+        return self._arrays
+
+
+class CompiledJaxDAG:
+    def __init__(self, fn, num_inputs: int, multi_output: bool,
+                 num_tasks: int, num_waves: int, wave_width: int,
+                 payload_shape, dtype, dynamic: bool, op_names: List[str]):
+        self._fn = fn
+        self.num_inputs = num_inputs
+        self.multi_output = multi_output
+        self.num_tasks = num_tasks
+        self.num_waves = num_waves
+        self.wave_width = wave_width
+        self.payload_shape = tuple(payload_shape)
+        self.dtype = dtype
+        self.dynamic = dynamic
+        self.op_names = op_names
+
+    def execute(self, *inputs) -> JaxDAGRef:
+        if len(inputs) != self.num_inputs:
+            raise ValueError(
+                f"compiled DAG takes {self.num_inputs} input(s), got "
+                f"{len(inputs)}")
+        if self.num_inputs:
+            stacked = jnp.stack(
+                [jnp.asarray(x, dtype=self.dtype).reshape(self.payload_shape)
+                 for x in inputs])
+        else:
+            stacked = jnp.zeros((0,) + self.payload_shape, self.dtype)
+        out = self._fn(stacked)
+        return JaxDAGRef(out, self.multi_output)
+
+    def __call__(self, *inputs):
+        return self.execute(*inputs).get()
+
+    def teardown(self):
+        """API parity with the actor-loop backend; nothing to stop here."""
+
+    def visualize_schedule(self) -> str:
+        return (
+            f"CompiledJaxDAG: {self.num_tasks} tasks, "
+            f"{self.num_waves} waves × width {self.wave_width}, "
+            f"{'dynamic frontier' if self.dynamic else 'static levels'}, "
+            f"payload {self.payload_shape} {jnp.dtype(self.dtype).name}, "
+            f"ops {self.op_names}"
+        )
+
+
+def compile_jax_dag(
+    leaf: DAGNode,
+    payload_shape: Sequence[int] = (),
+    dtype=jnp.float32,
+    dynamic: Optional[bool] = None,
+    max_args: Optional[int] = None,
+    fuse: bool = True,
+) -> CompiledJaxDAG:
+    """Lower a static DAG of jax-traceable FunctionNodes to one XLA program.
+
+    Every task op must map payload-shaped arrays to one payload-shaped array
+    (uniform buckets; heterogeneous payloads belong in separate compiled
+    graphs or the actor backend — see SURVEY.md §7 'hard parts').
+    """
+    if dynamic is None:
+        dynamic = GlobalConfig.wave_executor_dynamic
+    if max_args is None:
+        max_args = GlobalConfig.wave_executor_max_args
+
+    order = leaf.topological_order()
+
+    # ---- classify nodes, assign object slots --------------------------------
+    input_keys: List[Any] = []
+    slot_of: Dict[int, int] = {}  # id(node) -> object slot
+    tasks: List[FunctionNode] = []
+    plain_input_used = False
+
+    for node in order:
+        if isinstance(node, InputNode):
+            continue  # slot assigned via its consumers / attribute nodes
+        elif isinstance(node, InputAttributeNode):
+            if node._key not in input_keys:
+                input_keys.append(node._key)
+        elif isinstance(node, FunctionNode):
+            tasks.append(node)
+        elif isinstance(node, MultiOutputNode):
+            if node is not leaf:
+                raise ValueError("MultiOutputNode must be the DAG leaf")
+        elif isinstance(node, ClassMethodNode):
+            raise NotImplementedError(
+                "backend='jax' compiles stateless task DAGs; for stateful "
+                "actor pipelines use backend='actor' or "
+                "ray_tpu.dag.jax_pipeline (jax-state actors)")
+        else:
+            raise TypeError(f"cannot compile node type {type(node).__name__}")
+
+    consumes_plain_input = any(
+        isinstance(a, InputNode)
+        for t in tasks
+        for a in list(t._bound_args) + list(t._bound_kwargs.values())
+    )
+    if consumes_plain_input and input_keys:
+        raise ValueError(
+            "mix of whole-input and projected-input (inp[i]) consumption is "
+            "not supported in the jax backend")
+    if consumes_plain_input:
+        input_keys = [None]
+        plain_input_used = True
+    else:
+        # Positional execute(*inputs) maps to inp[k] by key order, matching
+        # the interpreted path's input_values[k] — NOT by topological
+        # first-appearance, which depends on graph shape.
+        if not all(isinstance(k, int) for k in input_keys):
+            raise ValueError(
+                "jax backend input projections must use integer keys "
+                f"(inp[0], inp[1], ...); got {input_keys!r}")
+        input_keys.sort()
+        if input_keys != list(range(len(input_keys))):
+            raise ValueError(
+                f"jax backend requires dense input keys 0..N-1; got "
+                f"{input_keys!r}")
+    num_inputs = len(input_keys)
+
+    # slots: [inputs..., task outputs...]
+    for node in order:
+        if isinstance(node, InputNode):
+            if plain_input_used:
+                slot_of[id(node)] = 0
+        elif isinstance(node, InputAttributeNode):
+            slot_of[id(node)] = input_keys.index(node._key)
+    for i, t in enumerate(tasks):
+        slot_of[id(t)] = num_inputs + i
+    # Last row is a scratch slot: padding lanes in a wave scatter there so
+    # they never collide with a real producer's slot.
+    scratch_slot = num_inputs + len(tasks)
+    num_slots = scratch_slot + 1
+
+    # ---- per-task IR --------------------------------------------------------
+    T = len(tasks)
+    if T == 0:
+        raise ValueError("DAG contains no tasks")
+    task_fns: List[Callable] = []
+    task_dep_slots: List[List[int]] = []
+    seen_fn_arities: Dict[Tuple[int, int], str] = {}
+
+    for t in tasks:
+        if t._bound_kwargs:
+            raise ValueError(
+                "jax backend requires positional bind() args "
+                f"(task {t.function.__name__!r} bound kwargs)")
+        deps = list(t._bound_args)
+        for a in deps:
+            if not isinstance(a, DAGNode):
+                raise ValueError(
+                    "jax backend requires all bind() args to be DAG nodes; "
+                    "close over constants instead")
+        if len(deps) > max_args:
+            raise ValueError(
+                f"task {t.function.__name__!r} has {len(deps)} args > "
+                f"max_args={max_args}; raise wave_executor_max_args or use "
+                f"dag.reduce_tree")
+        task_fns.append(t.function)
+        task_dep_slots.append([slot_of[id(a)] for a in deps])
+        seen_fn_arities[(id(t.function), len(deps))] = getattr(
+            t.function, "__name__", "op")
+
+    # ---- validate op shapes by abstract evaluation --------------------------
+    payload_shape = tuple(payload_shape)
+    aval = jax.ShapeDtypeStruct(payload_shape, dtype)
+    checked = set()
+    for fn, deps in zip(task_fns, task_dep_slots):
+        key = (id(fn), len(deps))
+        if key in checked:
+            continue
+        checked.add(key)
+        out_aval = jax.eval_shape(fn, *([aval] * len(deps)))
+        if (tuple(out_aval.shape) != payload_shape
+                or out_aval.dtype != jnp.dtype(dtype)):
+            raise ValueError(
+                f"op {seen_fn_arities[key]!r} maps "
+                f"{payload_shape}/{jnp.dtype(dtype).name} -> "
+                f"{tuple(out_aval.shape)}/{out_aval.dtype}; all ops must "
+                f"preserve the payload bucket")
+
+    # ---- output slots -------------------------------------------------------
+    if isinstance(leaf, MultiOutputNode):
+        leaf_slots = np.asarray(
+            [slot_of[id(a)] for a in leaf._bound_args], np.int32)
+        multi_output = True
+    else:
+        leaf_slots = np.asarray([slot_of[id(leaf)]], np.int32)
+        multi_output = False
+
+    # ---- linear-run fusion --------------------------------------------------
+    # A maximal chain t1 -> t2 -> ... -> tk where every interior output has
+    # exactly one consumer (the next task, arity 1) and is not a DAG output
+    # collapses into one macro-op: head fn applied to the head's args, then
+    # the tail sequence applied via an unrolled loop / lax.scan. This removes
+    # per-task object-table gather/scatter on sequential segments — the
+    # scheduler optimization that makes fine-grained chains run at scan
+    # speed instead of one wave per task.
+    producer_of_slot = {num_inputs + i: i for i in range(T)}
+    consumers: List[List[int]] = [[] for _ in range(T)]
+    external = [False] * T
+    for ti, deps in enumerate(task_dep_slots):
+        for s in deps:
+            p = producer_of_slot.get(s)
+            if p is not None:
+                consumers[p].append(ti)
+    for s in leaf_slots.tolist():
+        p = producer_of_slot.get(int(s))
+        if p is not None:
+            external[p] = True
+
+    _UNROLL_LIMIT = 16
+
+    def _make_macro(head_fn, head_arity, tail):
+        """Compose head + arity-1 tail fns into one payload->payload op."""
+        if not tail:
+            return head_fn
+        same = all(f is tail[0] for f in tail)
+        if len(tail) <= _UNROLL_LIMIT:
+            def macro(*args):
+                x = head_fn(*args)
+                for f in tail:
+                    x = f(x)
+                return x
+        elif same:
+            f = tail[0]
+            k = len(tail)
+
+            def macro(*args):
+                x = head_fn(*args)
+                return lax.scan(
+                    lambda c, _: (f(c), None), x, None, length=k)[0]
+        else:
+            uniq: List[Callable] = []
+            idx: Dict[int, int] = {}
+            seq = []
+            for f in tail:
+                if id(f) not in idx:
+                    idx[id(f)] = len(uniq)
+                    uniq.append(f)
+                seq.append(idx[id(f)])
+            seq_arr = jnp.asarray(np.asarray(seq, np.int32))
+
+            def macro(*args):
+                x = head_fn(*args)
+                return lax.scan(
+                    lambda c, o: (lax.switch(o, uniq, c), None),
+                    x, seq_arr)[0]
+        return macro
+
+    fused: List[Tuple[Callable, List[int], int, int, str]] = []
+    assigned = [False] * T
+    for ti in range(T):  # tasks[] is already topological
+        if assigned[ti]:
+            continue
+        run = [ti]
+        assigned[ti] = True
+        cur = ti
+        while (fuse and not external[cur] and len(consumers[cur]) == 1):
+            nxt = consumers[cur][0]
+            if assigned[nxt] or len(task_dep_slots[nxt]) != 1:
+                break
+            run.append(nxt)
+            assigned[nxt] = True
+            cur = nxt
+        head = run[0]
+        tail_fns = [task_fns[i] for i in run[1:]]
+        macro = _make_macro(task_fns[head], len(task_dep_slots[head]),
+                            tail_fns)
+        name = getattr(task_fns[head], "__name__", "op")
+        if tail_fns:
+            name = f"fused[{len(run)}]{name}"
+        fused.append((macro, task_dep_slots[head],
+                      num_inputs + run[-1], len(run), name))
+
+    # ---- compact op/task tables --------------------------------------------
+    C = len(fused)
+    op_index: Dict[Any, int] = {}
+    op_fns: List[Callable] = []
+    op_names: List[str] = []
+    arity_of: List[int] = []
+    op_ids = np.zeros(C, np.int32)
+    arg_slots = np.zeros((C, max_args), np.int32)
+    out_slots = np.zeros(C, np.int32)
+
+    for ci, (macro, deps, out_slot, size, name) in enumerate(fused):
+        # Fused macros are unique per run; plain ops dedupe by (fn, arity).
+        key = (id(macro), len(deps)) if size == 1 else ("run", ci)
+        if key not in op_index:
+            op_index[key] = len(op_fns)
+            op_fns.append(macro)
+            op_names.append(name)
+            arity_of.append(len(deps))
+        op_ids[ci] = op_index[key]
+        for ai, s in enumerate(deps):
+            arg_slots[ci, ai] = s
+        out_slots[ci] = out_slot
+
+    # Branches for lax.switch: stacked args [max_args, *P] -> [*P].
+    def _make_branch(fn, arity):
+        def branch(stacked):
+            return fn(*[stacked[i] for i in range(arity)])
+        return branch
+
+    branches = [
+        _make_branch(fn, ar) for fn, ar in zip(op_fns, arity_of)
+    ]
+    single_op = len(branches) == 1
+    arg_slots_dev = jnp.asarray(arg_slots)
+    out_slots_dev = jnp.asarray(out_slots)
+    op_ids_dev = jnp.asarray(op_ids)
+
+    def _run_tasks(obj, t_idx):
+        """Execute tasks t_idx (int32 [W], -1 = padding) against obj table."""
+        valid = t_idx >= 0
+        t = jnp.where(valid, t_idx, 0)
+        a_slots = arg_slots_dev[t]                      # [W, A]
+        stacked = obj[a_slots]                          # [W, A, *P]
+        if single_op:
+            outs = jax.vmap(branches[0])(stacked)       # [W, *P]
+        else:
+            ops = op_ids_dev[t]
+            outs = jax.vmap(
+                lambda o, s: lax.switch(o, branches, s))(ops, stacked)
+        slots = jnp.where(valid, out_slots_dev[t], scratch_slot)
+        return obj.at[slots].set(outs)
+
+    # Dependency structure over the compact task list (slot-level).
+    compact_producer = {int(s): ci for ci, s in enumerate(out_slots)}
+
+    if not dynamic:
+        # ---- static level schedule ------------------------------------------
+        levels = np.zeros(C, np.int32)
+        for ci, (_, deps, _, _, _) in enumerate(fused):
+            lvl = 0
+            for s in deps:
+                p = compact_producer.get(int(s))
+                if p is not None:
+                    lvl = max(lvl, levels[p] + 1)
+            levels[ci] = lvl
+        num_waves = int(levels.max()) + 1
+        waves: List[List[int]] = [[] for _ in range(num_waves)]
+        for ci in range(C):
+            waves[levels[ci]].append(ci)
+        wave_width = max(len(w) for w in waves)
+        sched = np.full((num_waves, wave_width), -1, np.int32)
+        for wi, w in enumerate(waves):
+            sched[wi, : len(w)] = w
+        sched_dev = jnp.asarray(sched)
+
+        def program(inputs):
+            obj = jnp.zeros((num_slots,) + payload_shape, dtype)
+            if num_inputs:
+                obj = obj.at[:num_inputs].set(inputs)
+            if num_waves == 1:
+                obj = _run_tasks(obj, sched_dev[0])
+            else:
+                obj = lax.fori_loop(
+                    0, num_waves,
+                    lambda w, o: _run_tasks(o, sched_dev[w]), obj)
+            out = obj[jnp.asarray(leaf_slots)]
+            return out if multi_output else out[0]
+
+    else:
+        # ---- dynamic frontier (lax.while_loop) ------------------------------
+        # Edge list producer-task -> consumer-task for in-degree updates.
+        edges_src: List[int] = []
+        edges_dst: List[int] = []
+        indeg0 = np.zeros(C, np.int32)
+        for ci, (_, deps, _, _, _) in enumerate(fused):
+            for s in deps:
+                src = compact_producer.get(int(s))
+                if src is not None:
+                    edges_src.append(src)
+                    edges_dst.append(ci)
+                    indeg0[ci] += 1
+        e_src = jnp.asarray(np.asarray(edges_src, np.int32))
+        e_dst = jnp.asarray(np.asarray(edges_dst, np.int32))
+        all_tasks = jnp.arange(C, dtype=jnp.int32)
+        num_waves = 0  # unknown statically
+        wave_width = C
+
+        def program(inputs):
+            obj = jnp.zeros((num_slots,) + payload_shape, dtype)
+            if num_inputs:
+                obj = obj.at[:num_inputs].set(inputs)
+            indeg = jnp.asarray(indeg0)
+            done = jnp.zeros(C, bool)
+
+            def cond(state):
+                _, _, done = state
+                return ~jnp.all(done)
+
+            def body(state):
+                obj, indeg, done = state
+                ready = (indeg == 0) & ~done
+                t_idx = jnp.where(ready, all_tasks, -1)
+                obj = _run_tasks(obj, t_idx)
+                done = done | ready
+                # Frontier expansion: decrement consumers of finished
+                # producers via a segment-sum over the edge list.
+                if e_src.shape[0]:
+                    fired = ready[e_src].astype(jnp.int32)
+                    indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
+                        fired)
+                return obj, indeg, done
+
+            obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
+            out = obj[jnp.asarray(leaf_slots)]
+            return out if multi_output else out[0]
+
+    fn = jax.jit(program)
+    dag = CompiledJaxDAG(
+        fn, num_inputs, multi_output, T,
+        num_waves, wave_width, payload_shape, dtype, dynamic, op_names,
+    )
+    dag.num_compiled_tasks = C
+    return dag

@@ -1,0 +1,236 @@
+"""DAG semantics: interpreted, actor-loop compiled, and JAX wave executor
+(reference role: python/ray/dag/tests/experimental/test_accelerated_dag.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode, reduce_tree
+
+
+@ray_tpu.remote
+def jadd1(x):
+    return x + 1
+
+
+@ray_tpu.remote
+def jdouble(x):
+    return x * 2
+
+
+@ray_tpu.remote
+def jsum2(a, b):
+    return a + b
+
+
+# ---------------------------------------------------------- interpreted path
+def test_interpreted_execute(ray_start_regular):
+    with InputNode() as inp:
+        dag = jadd1.bind(jdouble.bind(inp))
+    ref = dag.execute(10)
+    assert ray_tpu.get(ref) == 21
+
+
+def test_interpreted_multi_output(ray_start_regular):
+    with InputNode() as inp:
+        a = jadd1.bind(inp)
+        b = jdouble.bind(inp)
+        dag = MultiOutputNode([a, b])
+    refs = dag.execute(5)
+    assert ray_tpu.get(refs) == [6, 10]
+
+
+def test_interpreted_actor_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    a = Acc.remote()
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    assert ray_tpu.get(dag.execute(3)) == 3
+    assert ray_tpu.get(dag.execute(4)) == 7
+
+
+# -------------------------------------------------------- actor-loop backend
+def test_compiled_actor_pipeline(ray_start_regular):
+    @ray_tpu.remote
+    class Plus:
+        def __init__(self, n):
+            self.n = n
+
+        def apply(self, x):
+            return x + self.n
+
+    actors = [Plus.remote(i) for i in range(1, 5)]
+    with InputNode() as inp:
+        node = inp
+        for a in actors:
+            node = a.apply.bind(node)
+        dag = node
+    compiled = dag.experimental_compile(backend="actor")
+    try:
+        # 0 + 1 + 2 + 3 + 4 = 10
+        assert compiled.execute(0).get(timeout=10) == 10
+        # Repeat executions reuse the loops (no new tasks).
+        for i in range(10):
+            assert compiled.execute(i).get(timeout=10) == i + 10
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_stage_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def apply(self, x):
+            raise ValueError("stage failed")
+
+    a = Bad.remote()
+    with InputNode() as inp:
+        dag = a.apply.bind(inp)
+    compiled = dag.experimental_compile(backend="actor")
+    try:
+        with pytest.raises(ValueError, match="stage failed"):
+            compiled.execute(1).get(timeout=10)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_output(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def inc(self, x):
+            return x + 1
+
+        def dec(self, x):
+            return x - 1
+
+    a = Worker.remote()
+    b = Worker.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.inc.bind(inp), b.dec.bind(inp)])
+    compiled = dag.experimental_compile(backend="actor")
+    try:
+        assert compiled.execute(10).get(timeout=10) == [11, 9]
+    finally:
+        compiled.teardown()
+
+
+# ------------------------------------------------------------- jax backend
+def _noop(x):
+    return x
+
+
+def _inc(x):
+    return x + 1.0
+
+
+def _add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def noop(x):
+    return _noop(x)
+
+
+@ray_tpu.remote
+def inc(x):
+    return _inc(x)
+
+
+@ray_tpu.remote
+def add(a, b):
+    return _add(a, b)
+
+
+def test_jax_chain(ray_start_regular):
+    with InputNode() as inp:
+        node = inp
+        for _ in range(64):
+            node = inc.bind(node)
+    compiled = node.experimental_compile(backend="jax")
+    out = compiled.execute(0.0).get()
+    assert float(out) == 64.0
+    assert compiled.num_tasks == 64
+    # Linear-run fusion collapses the whole chain into one scan macro-op.
+    assert compiled.num_compiled_tasks == 1
+    assert compiled.num_waves == 1
+
+
+def test_jax_chain_unfused(ray_start_regular):
+    with InputNode() as inp:
+        node = inp
+        for _ in range(64):
+            node = inc.bind(node)
+    compiled = node.experimental_compile(backend="jax", fuse=False)
+    assert float(compiled.execute(0.0).get()) == 64.0
+    assert compiled.num_waves == 64
+    assert compiled.wave_width == 1
+
+
+def test_jax_fanout_fanin(ray_start_regular):
+    n = 256
+    with InputNode() as inp:
+        leaves = [inc.bind(inp) for _ in range(n)]
+        root = reduce_tree(add, leaves, arity=2)
+    compiled = root.experimental_compile(backend="jax")
+    out = compiled.execute(1.0).get()
+    # n copies of (1+1) summed.
+    assert float(out) == 2.0 * n
+    assert compiled.wave_width == n
+
+
+def test_jax_dynamic_frontier_matches_static(ray_start_regular):
+    with InputNode() as inp:
+        a = inc.bind(inp)
+        b = inc.bind(a)
+        c = add.bind(a, b)
+        d = add.bind(c, inp)
+    static = d.experimental_compile(backend="jax", dynamic=False)
+    dynamic = d.experimental_compile(backend="jax", dynamic=True)
+    assert float(static.execute(3.0).get()) == float(
+        dynamic.execute(3.0).get()) == (4 + 5) + 3
+
+
+def test_jax_multi_output(ray_start_regular):
+    with InputNode() as inp:
+        x = inc.bind(inp)
+        dag = MultiOutputNode([x, inc.bind(x)])
+    compiled = dag.experimental_compile(backend="jax")
+    a, b = compiled.execute(0.0).get()
+    assert float(a) == 1.0 and float(b) == 2.0
+
+
+def test_jax_vector_payload(ray_start_regular):
+    with InputNode() as inp:
+        dag = add.bind(inc.bind(inp), inc.bind(inp))
+    compiled = dag.experimental_compile(
+        backend="jax", payload_shape=(8,), dtype=np.float32)
+    out = compiled.execute(np.zeros(8, np.float32)).get()
+    np.testing.assert_allclose(out, np.full(8, 2.0))
+
+
+def test_jax_multiple_inputs(ray_start_regular):
+    with InputNode() as inp:
+        dag = add.bind(noop.bind(inp[0]), noop.bind(inp[1]))
+    compiled = dag.experimental_compile(backend="jax")
+    assert float(compiled.execute(2.0, 5.0).get()) == 7.0
+
+
+def test_jax_shape_mismatch_rejected(ray_start_regular):
+    @ray_tpu.remote
+    def bad(x):
+        import jax.numpy as jnp
+
+        return jnp.stack([x, x])
+
+    with InputNode() as inp:
+        dag = bad.bind(inp)
+    with pytest.raises(ValueError, match="payload bucket"):
+        dag.experimental_compile(backend="jax")
