@@ -1,0 +1,24 @@
+"""Model zoo for the TPU-native framework.
+
+The reference ships model code through RLlib modules and Train integrations
+(torch); here the flagship is a jax-native decoder-only transformer wired
+directly into the parallelism layer (dp/pp/tp/sp/ep over one Mesh).
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_spmd_train_step,
+    param_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_spmd_train_step",
+    "param_specs",
+]

@@ -1,0 +1,493 @@
+"""Flagship model: decoder-only transformer, TPU-first.
+
+Design notes (vs the reference, which delegates all model math to torch —
+SURVEY.md §2.6): everything here is built for the MXU and the Mesh:
+
+- bfloat16 activations, f32 params/optimizer state; all FLOPs in batched
+  einsums that tile onto the systolic array; static shapes throughout.
+- Layers are **stacked** ([L, ...] leading axis) and run under ``lax.scan``
+  → one compiled layer body regardless of depth, with optional
+  ``jax.checkpoint`` rematerialisation for HBM.
+- Two execution paths:
+  1. ``forward`` / ``loss_fn``: GSPMD path — logical sharding constraints
+     (ShardingRules) and jit; XLA inserts the dp/fsdp/tp collectives.
+  2. ``make_spmd_train_step``: manual path — ``jax.shard_map`` over the
+     full (dp, pp, tp, sp, ep) mesh with explicit collectives: Megatron
+     column/row TP with psum, ring attention over sp, MoE all_to_all over
+     ep, GPipe ppermute over pp, gradient psum-mean over dp. This is the
+     multi-chip training step the driver dry-runs.
+
+GQA attention with rotary embeddings, RMSNorm, SwiGLU MLP, optional MoE
+layers every ``moe_every``-th layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import mesh_shape
+from ray_tpu.parallel.moe import moe_dispatch_combine
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1376
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    # MoE: 0 = dense; otherwise every `moe_every`-th layer is MoE.
+    num_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _dense_init(key, shape, fan_in):
+    return (jax.random.normal(key, shape, jnp.float32)
+            * (1.0 / math.sqrt(fan_in)))
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """Stacked-layer param pytree. Weights f32 (master copy)."""
+    D, F, Hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+    nq, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    ks = jax.random.split(key, 10)
+
+    def stack(initfn):
+        keys = jax.random.split(ks[9], L)
+        return jax.vmap(initfn)(keys)
+
+    layers = {
+        "attn_norm": jnp.ones((L, D), jnp.float32),
+        "wq": stack(lambda k: _dense_init(k, (D, nq * Hd), D)),
+        "wk": stack(lambda k: _dense_init(k, (D, nkv * Hd), D)),
+        "wv": stack(lambda k: _dense_init(k, (D, nkv * Hd), D)),
+        "wo": stack(lambda k: _dense_init(k, (nq * Hd, D), nq * Hd)),
+        "mlp_norm": jnp.ones((L, D), jnp.float32),
+        "w_gate": stack(lambda k: _dense_init(k, (D, F), D)),
+        "w_up": stack(lambda k: _dense_init(k, (D, F), D)),
+        "w_down": stack(lambda k: _dense_init(k, (F, D), F)),
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers["router"] = stack(lambda k: _dense_init(k, (D, E), D))
+        layers["e_gate"] = stack(
+            lambda k: _dense_init(k, (E, D, F), D))
+        layers["e_up"] = stack(lambda k: _dense_init(k, (E, D, F), D))
+        layers["e_down"] = stack(lambda k: _dense_init(k, (E, F, D), F))
+    return {
+        "embed": jax.random.normal(ks[0], (cfg.vocab_size, D),
+                                   jnp.float32) * 0.02,
+        "layers": layers,
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": _dense_init(ks[1], (D, cfg.vocab_size), D),
+    }
+
+
+def param_specs(cfg: TransformerConfig,
+                rules: Optional[ShardingRules] = None) -> Dict[str, Any]:
+    """PartitionSpec tree matching init_params (GSPMD path).
+
+    Layer weights carry a leading stacked-layer axis: sharded on pp when a
+    pipeline mesh is used (stages = contiguous layer blocks), else None.
+    2D weights shard wide-axis on tp, narrow on fsdp (ZeRO-3).
+    """
+    r = rules or ShardingRules()
+    st, tp, fs = r.stage, r.mlp, r.fsdp_shard
+    layers = {
+        "attn_norm": P(st, None),
+        "wq": P(st, fs, tp), "wk": P(st, fs, tp), "wv": P(st, fs, tp),
+        "wo": P(st, tp, fs),
+        "mlp_norm": P(st, None),
+        "w_gate": P(st, fs, tp), "w_up": P(st, fs, tp),
+        "w_down": P(st, tp, fs),
+    }
+    if cfg.num_experts:
+        layers.update({
+            "router": P(st, None, None),
+            "e_gate": P(st, r.expert, None, tp),
+            "e_up": P(st, r.expert, None, tp),
+            "e_down": P(st, r.expert, tp, None),
+        })
+    return {
+        "embed": P(r.vocab, None),
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P(fs, r.vocab),
+    }
+
+
+def rms_norm(x, w, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def rope(x, positions, theta):
+    # x: [B, S, H, Dh]; rotate pairs (even, odd halves).
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = jnp.exp(
+        -jnp.arange(0, half, dtype=jnp.float32) * (math.log(theta) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def _attention_dense(q, k, v, causal=True):
+    """q [B,S,Hq,Dh], k/v [B,S,Hkv,Dh] -> [B,S,Hq,Dh] (GQA via repeat)."""
+    B, S, Hq, Dh = q.shape
+    Hkv = k.shape[2]
+    if Hq != Hkv:
+        k = jnp.repeat(k, Hq // Hkv, axis=2)
+        v = jnp.repeat(v, Hq // Hkv, axis=2)
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * (Dh ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
+    return o.transpose(0, 2, 1, 3)
+
+
+def _layer_fn(cfg: TransformerConfig, lp: Dict[str, jax.Array], x: jax.Array,
+              positions: jax.Array, layer_idx: jax.Array,
+              sp_axis: Optional[str] = None,
+              ep_axis: Optional[str] = None,
+              tp_axis: Optional[str] = None) -> jax.Array:
+    """One transformer block. In manual mode the weights arriving here are
+    the local TP shard (wide axis pre-sliced) and attention/MoE take the
+    collective axes to use; in GSPMD mode all axes are None."""
+    dt = cfg.dtype
+    B, S, D = x.shape
+    Hd = cfg.head_dim
+
+    # ---- attention ----------------------------------------------------------
+    h = rms_norm(x, lp["attn_norm"])
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, -1, Hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, -1, Hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, -1, Hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    if sp_axis is not None:
+        Hq, Hkv = q.shape[2], k.shape[2]
+        if Hq != Hkv:
+            k = jnp.repeat(k, Hq // Hkv, axis=2)
+            v = jnp.repeat(v, Hq // Hkv, axis=2)
+        o = ring_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), axis_name=sp_axis, causal=True,
+        ).transpose(0, 2, 1, 3)
+    else:
+        o = _attention_dense(q, k, v)
+    o = o.reshape(B, S, -1) @ lp["wo"].astype(dt)
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)  # row-parallel output proj
+    x = x + o
+
+    # ---- mlp ---------------------------------------------------------------
+    h = rms_norm(x, lp["mlp_norm"])
+    if cfg.num_experts and "router" in lp:
+        is_moe = (layer_idx % cfg.moe_every) == (cfg.moe_every - 1)
+        logits = (h.astype(jnp.float32)
+                  @ lp["router"].astype(jnp.float32)).reshape(
+            B * S, cfg.num_experts)
+
+        def expert_fn(tok):  # [E_local, C, D]
+            g = jnp.einsum("ecd,edf->ecf", tok, lp["e_gate"].astype(dt))
+            u = jnp.einsum("ecd,edf->ecf", tok, lp["e_up"].astype(dt))
+            out = jnp.einsum(
+                "ecf,efd->ecd", jax.nn.silu(g) * u, lp["e_down"].astype(dt))
+            if tp_axis is not None:
+                out = lax.psum(out, tp_axis)  # row-parallel e_down
+            return out
+
+        if ep_axis is not None:
+            moe_out = moe_dispatch_combine(
+                h.reshape(B * S, D), logits, expert_fn,
+                num_experts=cfg.num_experts,
+                capacity_factor=cfg.capacity_factor,
+                axis_name=ep_axis).reshape(B, S, D)
+        else:
+            # Dense fallback: run all experts, weight by top-1 gate.
+            probs = jax.nn.softmax(logits, axis=-1)
+            top = jnp.argmax(probs, axis=-1)
+            gate = probs[jnp.arange(B * S), top].astype(dt)
+            toks = jnp.broadcast_to(
+                h.reshape(1, B * S, D), (cfg.num_experts, B * S, D))
+            outs = expert_fn(toks)
+            moe_out = (outs[top, jnp.arange(B * S)]
+                       * gate[:, None]).reshape(B, S, D)
+        if cfg.moe_every == 1:
+            m = moe_out  # all layers MoE: skip the dense branch entirely
+        else:
+            dense_out = _swiglu(cfg, lp, h, tp_axis)
+            m = jnp.where(is_moe, moe_out, dense_out)
+    else:
+        m = _swiglu(cfg, lp, h, tp_axis)
+    return x + m
+
+
+def _swiglu(cfg, lp, h, tp_axis):
+    dt = cfg.dtype
+    g = h @ lp["w_gate"].astype(dt)
+    u = h @ lp["w_up"].astype(dt)
+    out = (jax.nn.silu(g) * u) @ lp["w_down"].astype(dt)
+    if tp_axis is not None:
+        out = lax.psum(out, tp_axis)  # row-parallel down proj
+    return out
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: jax.Array,
+            mesh: Optional[Mesh] = None,
+            rules: Optional[ShardingRules] = None) -> jax.Array:
+    """GSPMD path: tokens [B, S] -> logits [B, S, V]. Layers via lax.scan."""
+    r = rules or ShardingRules()
+
+    def constrain(x, *logical):
+        if mesh is None:
+            return x
+        return lax.with_sharding_constraint(
+            x, NamedSharding(mesh, r.spec(*logical)))
+
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    x = constrain(x, "batch", "sequence", "embed")
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    def body(carry, lp_with_idx):
+        x = carry
+        lp, idx = lp_with_idx
+
+        def run(x):
+            return _layer_fn(cfg, lp, x, positions, idx)
+
+        x = jax.checkpoint(run)(x) if cfg.remat else run(x)
+        x = constrain(x, "batch", "sequence", "embed")
+        return x, None
+
+    idxs = jnp.arange(cfg.n_layers)
+    x, _ = lax.scan(body, x, (params["layers"], idxs))
+    x = rms_norm(x, params["final_norm"])
+    logits = x @ params["lm_head"].astype(dt)
+    return constrain(logits.astype(jnp.float32), "batch", "sequence", "vocab")
+
+
+def loss_fn(cfg: TransformerConfig, params, tokens, targets,
+            mesh=None, rules=None) -> jax.Array:
+    logits = forward(cfg, params, tokens, mesh, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# Manual SPMD training step: shard_map over (dp, pp, tp, sp, ep).
+# ---------------------------------------------------------------------------
+
+def _stage_params_spec(cfg: TransformerConfig) -> Dict[str, P]:
+    """in_specs for the stacked layer tree inside shard_map: leading layer
+    axis sharded over pp, wide weight axes over tp, experts over ep."""
+    sp = {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, "tp"), "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"), "wo": P("pp", "tp", None),
+        "mlp_norm": P("pp", None),
+        "w_gate": P("pp", None, "tp"), "w_up": P("pp", None, "tp"),
+        "w_down": P("pp", "tp", None),
+    }
+    if cfg.num_experts:
+        sp.update({
+            "router": P("pp", None, None),
+            "e_gate": P("pp", "ep", None, "tp"),
+            "e_up": P("pp", "ep", None, "tp"),
+            "e_down": P("pp", "ep", "tp", None),
+        })
+    return sp
+
+
+def make_spmd_train_step(cfg: TransformerConfig, mesh: Mesh, params,
+                         optimizer=None, n_microbatches: int = 2):
+    """Build the manual multi-chip training step.
+
+    Returns ``(step, pspec, ospec)`` where ``step(params, opt_state,
+    tokens, targets) -> (params, opt_state, loss)`` is a jitted
+    ``shard_map`` over the full mesh with explicit collectives on every
+    axis, and pspec/ospec are the PartitionSpec trees for params and
+    optimizer state (``params`` is only shape-inspected — pass real or
+    ``jax.eval_shape`` abstract values).
+
+    Requires cfg.n_layers % pp == 0, heads % tp == 0, batch % (dp*mb) == 0,
+    seq % sp == 0, experts % ep == 0 (when MoE).
+    """
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4)
+    shape = mesh_shape(mesh)
+    pp, tp, sp_n, ep_n = shape["pp"], shape["tp"], shape["sp"], shape["ep"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers {cfg.n_layers} % pp {pp} != 0")
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError("heads must divide tp")
+    if cfg.num_experts and cfg.num_experts % ep_n:
+        raise ValueError("experts must divide ep")
+    layers_per_stage = cfg.n_layers // pp
+
+    lp_spec = _stage_params_spec(cfg)
+    pspec = {
+        "embed": P(None, None),
+        "layers": lp_spec,
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    }
+    data_spec = P(("dp", "fsdp"), "sp")
+
+    sp_axis = "sp" if sp_n > 1 else None
+    ep_axis = "ep" if ep_n > 1 else None
+    tp_axis = "tp" if tp > 1 else None
+
+    def stage_fn(stage_layers, act, stage_idx):
+        """Run this pp-shard's layers_per_stage layers over activation
+        bucket act = (x, positions)."""
+        x, positions = act
+
+        def body(carry, lp_i):
+            lp, local_i = lp_i
+            gidx = stage_idx * layers_per_stage + local_i
+
+            def run(x):
+                return _layer_fn(cfg, lp, x, positions, gidx,
+                                 sp_axis=sp_axis, ep_axis=ep_axis,
+                                 tp_axis=tp_axis)
+
+            x = jax.checkpoint(run)(carry) if cfg.remat else run(carry)
+            return x, None
+
+        x, _ = lax.scan(
+            body, x, (stage_layers, jnp.arange(layers_per_stage)))
+        return x, positions
+
+    def local_loss(params, tokens, targets):
+        """Per-shard loss: tokens [B_local, S_local] (dp×sp sharded)."""
+        B, S = tokens.shape
+        dt = cfg.dtype
+        stage = lax.axis_index("pp")
+        x = params["embed"].astype(dt)[tokens]
+        s_idx = lax.axis_index("sp") if sp_n > 1 else 0
+        positions = jnp.broadcast_to(
+            jnp.arange(S) + s_idx * S, (B, S))
+
+        if pp > 1:
+            from ray_tpu.parallel.pipeline import pipeline_spmd
+            mb = n_microbatches
+            if B % mb:
+                raise ValueError(f"local batch {B} % microbatches {mb}")
+            xs = x.reshape(mb, B // mb, S, -1)
+            pos_mb = jnp.broadcast_to(positions[: B // mb], xs.shape[:3])
+            out, _ = pipeline_spmd(
+                lambda lp, act: stage_fn(lp, act, lax.axis_index("pp")),
+                params["layers"], (xs, pos_mb), axis_name="pp")
+            x = out.reshape(B, S, -1)
+        else:
+            x, _ = stage_fn(params["layers"], (x, positions),
+                            jnp.zeros((), jnp.int32))
+
+        x = rms_norm(x, params["final_norm"])
+        logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    from ray_tpu.parallel.mesh import AXES
+
+    n_total = math.prod(shape[a] for a in AXES)
+
+    def _sync_grads(grads):
+        """Per-leaf gradient sync. Inside shard_map, jax.grad returns on
+        each shard d(sum of every shard's local_loss)/d(local leaf). Since
+        local_loss is the local-token mean (distinct across dp/fsdp/sp,
+        replicated as a function across tp/pp/ep), the global-mean gradient
+        of a leaf sharded over axes S is psum over the complement of S,
+        scaled by 1/N_devices — one rule covers replicated and sharded
+        leaves alike."""
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_s = jax.tree.leaves(pspec, is_leaf=lambda x: isinstance(x, P))
+        out = []
+        for g, s in zip(flat_g, flat_s):
+            sharded = set()
+            for part in s:
+                if part is None:
+                    continue
+                for ax in (part if isinstance(part, tuple) else (part,)):
+                    sharded.add(ax)
+            repl = tuple(a for a in AXES if a not in sharded)
+            out.append(lax.psum(g, repl) / n_total)
+        return jax.tree.unflatten(treedef, out)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        grads = _sync_grads(grads)
+        loss = lax.pmean(loss, ("dp", "fsdp", "sp"))
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    # Optimizer-state sharding: optax states embed whole param-shaped
+    # subtrees (mu/nu — must carry the params' specs) plus scalar leaves
+    # (counts — replicate). Substitute pspec wherever a subtree's treedef
+    # matches the params' treedef; shape-matching would be unsound (wq/wo
+    # share a global shape but transpose their tp axis).
+    params_treedef = jax.tree.structure(params)
+
+    def _is_param_tree(x):
+        try:
+            return jax.tree.structure(x) == params_treedef
+        except Exception:
+            return False
+
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    ospec = jax.tree.map(
+        lambda sub: pspec if _is_param_tree(sub) else P(),
+        opt_shapes, is_leaf=_is_param_tree)
+
+    step_sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(pspec, ospec, data_spec, data_spec),
+        out_specs=(pspec, ospec, P()),
+        check_vma=False)
+    return jax.jit(step_sm), pspec, ospec
+
+
+def shard_params_for_step(params, mesh, pspec):
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, pspec)

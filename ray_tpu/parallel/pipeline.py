@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe microbatching over the ``pp`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.4 — integration-only). TPU-native
+formulation: every pp-shard holds one stage's parameters; activations hop
+stage→stage via ``lax.ppermute`` inside a ``fori_loop`` over
+``n_stages + n_microbatches - 1`` ticks (the bubble is the standard GPipe
+cost). Autodiff is free: the transpose of ppermute is the reverse
+permute, so backward runs the pipeline in reverse without extra code.
+
+Call inside ``shard_map`` over ``pp``; stage params must already be the
+local stage's slice. Activations may be any pytree (every leaf needs a
+leading microbatch axis in ``microbatches``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(
+    stage_fn: Callable[[Any, Any], Any],
+    stage_params: Any,
+    microbatches: Any,
+    *,
+    axis_name: str = "pp",
+) -> Any:
+    """Run ``stage_fn`` as a GPipe pipeline.
+
+    stage_fn(stage_params, act) -> act' with act/act' the same pytree
+    structure and leaf shapes (the inter-stage activation bucket).
+    ``microbatches``: pytree with leading axis M on every leaf, present on
+    every shard (only stage 0 reads it). Returns the same pytree — the last
+    stage's outputs, broadcast to all shards via psum so downstream loss
+    code is uniform.
+    """
+    n = lax.axis_size(axis_name)
+    M = jax.tree.leaves(microbatches)[0].shape[0]
+    if n == 1:
+        return jax.vmap(lambda a: stage_fn(stage_params, a))(microbatches)
+    stage = lax.axis_index(axis_name)
+    total = n + M - 1
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def _index(tree, i):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tree)
+
+    def tick(t, carry):
+        act_in, outputs = carry
+        # Stage 0 injects microbatch t (clamped; inactive ticks compute
+        # values that are never written anywhere).
+        x0 = _index(microbatches, jnp.clip(t, 0, M - 1))
+        inp = jax.tree.map(
+            lambda a, b: jnp.where(stage == 0, a, b), x0, act_in)
+        out = stage_fn(stage_params, inp)
+        out_idx = t - (n - 1)
+        is_valid = (stage == n - 1) & (out_idx >= 0) & (out_idx < M)
+        safe = jnp.clip(out_idx, 0, M - 1)
+        prev = _index(outputs, safe)
+        outputs = jax.tree.map(
+            lambda buf, o, p: lax.dynamic_update_index_in_dim(
+                buf, jnp.where(is_valid, o, p), safe, 0),
+            outputs, out, prev)
+        act_next = jax.tree.map(
+            lambda a: lax.ppermute(a, axis_name, perm), out)
+        return act_next, outputs
+
+    act0 = _index(jax.tree.map(jnp.zeros_like, microbatches), 0)
+    outs0 = jax.tree.map(jnp.zeros_like, microbatches)
+    _, outputs = lax.fori_loop(0, total, tick, (act0, outs0))
+    # Only the last stage holds real outputs; broadcast so every shard
+    # returns the same value (grad of psum = identity per shard — correct).
+    outputs = jax.tree.map(
+        lambda o: lax.psum(jnp.where(stage == n - 1, o, jnp.zeros_like(o)),
+                           axis_name),
+        outputs)
+    return outputs

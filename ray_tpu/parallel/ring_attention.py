@@ -1,0 +1,107 @@
+"""Ring attention: exact blockwise attention over a context-parallel axis.
+
+Absent from the reference (SURVEY.md §2.4/§5.7) — long-context is a
+first-class capability here. Each sp-shard holds a sequence block of Q/K/V;
+K/V blocks rotate around the ring via ``lax.ppermute`` while each device
+accumulates its queries' attention online (flash-attention style running
+max/sum), so the full O(S^2) score matrix never materializes on one chip and
+comm overlaps compute around the ICI ring.
+
+Call inside ``shard_map`` over the ``sp`` axis (see models/transformer.py),
+with Q/K/V already sharded on the sequence axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, scale):
+    # q: [B, H, Sq, D], k/v: [B, H, Sk, D] -> scores [B, H, Sq, Sk]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # Guard fully-masked rows (all -inf): exp(0)=1 row but weight 0 below.
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m_safe, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with K/V ring-rotated over ``axis_name``.
+
+    Shapes (per shard): q/k/v [B, H, S_local, D]. Requires the global
+    sequence laid out contiguously across the axis (shard i holds tokens
+    [i*S_local, (i+1)*S_local)). Returns [B, H, S_local, D].
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_local = q.shape[2]
+
+    q_pos = my * s_local + jnp.arange(s_local)
+
+    def causal_bias(kv_shard):
+        k_pos = kv_shard * s_local + jnp.arange(s_local)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        return jnp.where(mask, 0.0, NEG_INF)[None, None]
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        kv_shard = (my - i) % n
+        bias = causal_bias(kv_shard) if causal else None
+        o_i, m_i, l_i = _block_attn(q, k_cur, v_cur, bias, scale)
+        # Online softmax merge of (o, m, l) with the new block.
+        m_new = jnp.maximum(m, m_i)
+        a = jnp.exp(m - m_new)
+        b = jnp.exp(m_i - m_new)
+        o = o * a + o_i * b
+        l = l * a + l_i * b
+        # Rotate K/V one hop around the ring (device d -> d+1).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o, m_new, l, k_nxt, v_nxt
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full(q.shape[:3] + (1,), NEG_INF, q.dtype)
+    l0 = jnp.zeros(q.shape[:3] + (1,), q.dtype)
+    if n == 1:
+        bias = causal_bias(0) if causal else None
+        o, _, l = _block_attn(q, k, v, bias, scale)
+        return o / jnp.maximum(l, 1e-30)
+    o, m, l, _, _ = lax.fori_loop(
+        0, n, step, (o0, m0, l0, k, v), unroll=True)
+    return o / jnp.maximum(l, 1e-30)
+
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """Unsharded exact attention, for tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -1,0 +1,85 @@
+"""Logical-axis sharding rules → NamedSharding.
+
+The reference has no native TP/FSDP — params are sharded by torch FSDP or
+DeepSpeed inside user code (SURVEY.md §2.4). Here sharding is a first-class
+framework concept: model code names its array axes logically ("embed",
+"mlp", "heads", ...), a ShardingRules table maps logical names to mesh axes,
+and XLA/GSPMD inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxis = Union[None, str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Map from logical array-axis names to mesh axis (or None = replicate).
+
+    Defaults implement the standard megatron-style recipe:
+    - batch over (dp, fsdp); sequence over sp (context parallel)
+    - embed replicated; per-layer weights sharded on tp along the
+      "wide" axis (mlp hidden, attention heads) and on fsdp along the other
+      (ZeRO-3 — all-gathered per layer by XLA)
+    - experts over ep; pipeline stages over pp (stacked-layer leading axis)
+    """
+
+    batch: MeshAxis = ("dp", "fsdp")
+    sequence: MeshAxis = "sp"
+    embed: MeshAxis = None
+    mlp: MeshAxis = "tp"
+    heads: MeshAxis = "tp"
+    kv_heads: MeshAxis = "tp"
+    head_dim: MeshAxis = None
+    vocab: MeshAxis = "tp"
+    expert: MeshAxis = "ep"
+    stage: MeshAxis = "pp"
+    fsdp_shard: MeshAxis = "fsdp"  # axis that ZeRO-shards 2D weights
+
+    def spec(self, *logical: Optional[str]) -> P:
+        """PartitionSpec for an array whose axes have these logical names."""
+        out = []
+        for name in logical:
+            if name is None:
+                out.append(None)
+            else:
+                out.append(getattr(self, name))
+        return P(*out)
+
+
+def logical_sharding(
+    mesh: Mesh, rules: ShardingRules, *logical: Optional[str]
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(*logical))
+
+
+def with_sharding_constraint(x, mesh: Optional[Mesh], spec: P):
+    """Annotate an intermediate; no-op outside jit or without a mesh."""
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_params(params: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Device-put a parameter pytree according to a matching tree of
+    PartitionSpecs (as produced by a model's ``param_specs()``)."""
+    def _put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(_put, params, spec_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def param_sharding_tree(mesh: Mesh, spec_tree: Any) -> Any:
+    """Tree of NamedShardings from a tree of PartitionSpecs (for jit
+    in_shardings / out_shardings arguments)."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,60 @@
+"""Ulysses (all-to-all) sequence parallelism.
+
+Absent from the reference (SURVEY.md §2.4). DeepSpeed-Ulysses recipe,
+TPU-native: inputs arrive sequence-sharded over ``sp``; an ``all_to_all``
+re-shards to head-sharded/sequence-full, attention runs locally with every
+token visible, and a second ``all_to_all`` restores sequence sharding.
+Two all-to-alls on ICI replace the ring's n-1 permutes — better when
+head count ≥ axis size and the full sequence fits per-chip.
+
+Call inside ``shard_map`` over the ``sp`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.ring_attention import reference_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """q/k/v per-shard [B, H, S_local, D] (sequence-sharded) ->
+    [B, H, S_local, D]. H must be divisible by the axis size."""
+    n = lax.axis_size(axis_name)
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: reference_attention(  # noqa: E731
+            q, k, v, causal=causal, scale=scale)
+    if n == 1:
+        return attn_fn(q, k, v)
+    B, H, S, D = q.shape
+    if H % n:
+        raise ValueError(f"heads {H} not divisible by {axis_name} size {n}")
+
+    def seq_to_heads(x):
+        # [B, H, S_local, D] -> [B, H/n, S_global, D]: scatter head groups
+        # to their shard, gather the full sequence (shard order = token
+        # order, so the concat restores the global sequence).
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # inverse: [B, H/n, S_global, D] -> [B, H, S_local, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = attn_fn(qh, kh, vh)
+    return heads_to_seq(oh)

@@ -8,8 +8,12 @@ run without real hardware — here an 8-device virtual CPU mesh via
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process. The TPU
+# tunnel plugin (axon) may still register itself as the default backend, so
+# RAY_TPU_PLATFORM pins every make_mesh() in the framework to the virtual
+# 8-device CPU backend regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["RAY_TPU_PLATFORM"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -38,3 +42,13 @@ def eight_device_mesh():
         "tests require XLA_FLAGS=--xla_force_host_platform_device_count=8"
     )
     yield devices[:8]
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _pin_cpu_platform():
+    # Single-device jax ops in tests must also land on CPU even when the
+    # axon TPU plugin registered itself as default.
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    yield
