@@ -2,7 +2,7 @@
 """Microbenchmark suite (reference role: release/microbenchmark +
 ray microbenchmark CLI).
 
-Measures the BASELINE.json metric — tasks/sec + p50 task latency on the
+Measures the BASELINE.json metric — tasks/sec + task latency on the
 chain and fan-out suites — on the compiled JAX wave executor (the
 TPU-resident scheduler that replaces the reference's raylet hot path).
 North-star target: >=100k fine-grained tasks/sec (BASELINE.json:north_star);
@@ -61,12 +61,12 @@ def bench_chain(n_tasks=1000, n_iters=10):
             node = noop.bind(node)
     compiled = node.experimental_compile(backend="jax")
     compiled.execute(0.0).get()  # warmup/compile
-    med = _time_pipelined(compiled, n_iters, 0.0)
+    amortized = _time_pipelined(compiled, n_iters, 0.0)
     return {
         "suite": "chain_1k_noop",
-        "tasks_per_sec": n_tasks / med,
-        "p50_task_latency_us": med / n_tasks * 1e6,
-        "p50_wall_s": med,
+        "tasks_per_sec": n_tasks / amortized,
+        "task_latency_us": amortized / n_tasks * 1e6,
+        "wall_s_per_exec": amortized,
         "num_tasks": n_tasks,
     }
 
@@ -94,12 +94,12 @@ def bench_fanout(width=10_000, n_iters=10):
     n_total = compiled.num_tasks
     out = compiled.execute(1.0).get()  # warmup + parity check
     assert float(out) == float(width), f"fan-in parity: {out} != {width}"
-    med = _time_pipelined(compiled, n_iters, 1.0)
+    amortized = _time_pipelined(compiled, n_iters, 1.0)
     return {
         "suite": "fanout_10k",
-        "tasks_per_sec": n_total / med,
-        "p50_task_latency_us": med / n_total * 1e6,
-        "p50_wall_s": med,
+        "tasks_per_sec": n_total / amortized,
+        "task_latency_us": amortized / n_total * 1e6,
+        "wall_s_per_exec": amortized,
         "num_tasks": n_total,
     }
 
@@ -210,7 +210,7 @@ def main():
     # Headline: total tasks over total wall time across chain + fan-out
     # (the BASELINE.json metric pair).
     total_tasks = chain["num_tasks"] + fanout["num_tasks"]
-    total_time = chain["p50_wall_s"] + fanout["p50_wall_s"]
+    total_time = chain["wall_s_per_exec"] + fanout["wall_s_per_exec"]
     tasks_per_sec = total_tasks / total_time
     print(json.dumps({
         "metric": "tasks_per_sec (chain 1k + fanout 10k, compiled jax DAG)",

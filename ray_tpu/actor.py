@@ -31,6 +31,17 @@ from ray_tpu.exceptions import (
 _TERMINATE = object()
 
 
+class _ClosureCall:
+    """A raw closure run on the actor's execution loop with the instance —
+    used by compiled DAGs to host their long-running exec loop inside the
+    actor (serialized with normal method calls, do_exec_tasks parity)."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
 class _MethodCall:
     __slots__ = ("method_name", "args", "kwargs", "return_ids", "name",
                  "cancelled")
@@ -112,13 +123,19 @@ class _ActorRuntime:
                 if call is _TERMINATE:
                     pool.shutdown(wait=False)
                     return
-                pool.submit(self._execute_call, worker, call)
+                if isinstance(call, _ClosureCall):
+                    pool.submit(call.fn, self.instance)
+                else:
+                    pool.submit(self._execute_call, worker, call)
         else:
             while True:
                 call = mailbox.get()
                 if call is _TERMINATE:
                     return
-                self._execute_call(worker, call)
+                if isinstance(call, _ClosureCall):
+                    call.fn(self.instance)
+                else:
+                    self._execute_call(worker, call)
 
     def _run_async(self, mailbox):
         loop = asyncio.new_event_loop()
@@ -135,6 +152,13 @@ class _ActorRuntime:
                 call = await loop.run_in_executor(None, mailbox.get)
                 if call is _TERMINATE:
                     return
+                if isinstance(call, _ClosureCall):
+                    # Blocking exec loop: keep it off the event loop so the
+                    # async actor's coroutines stay responsive (async actors
+                    # interleave by contract, so no serialization promise is
+                    # broken here).
+                    loop.run_in_executor(None, call.fn, self.instance)
+                    continue
                 await sem.acquire()
 
                 async def _run(call=call):
@@ -213,6 +237,8 @@ class _ActorRuntime:
                 continue
             if call is _TERMINATE:
                 return
+            if isinstance(call, _ClosureCall):
+                continue  # compiled-DAG loop: its compile-time check reports
             self._fail_call(worker, call, err)
 
     # ------------------------------------------------------------ submission
@@ -237,6 +263,13 @@ class _ActorRuntime:
         with self._lock:
             self._mailbox.put(call)
         return refs
+
+    def submit_exec_loop(self, fn):
+        """Enqueue a long-running closure (compiled-DAG exec loop); it runs
+        on the actor's loop thread with the instance and occupies the actor
+        until it returns (teardown)."""
+        with self._lock:
+            self._mailbox.put(_ClosureCall(fn))
 
     # ------------------------------------------------------------- lifecycle
     def terminate(self, no_restart: bool = True):

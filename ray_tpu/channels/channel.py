@@ -107,16 +107,22 @@ class BufferedChannel(Channel):
         self._lock = threading.Lock()
 
     def write(self, value: Any, timeout: Optional[float] = None):
+        # Cursor advances only after the slot op succeeds, so a
+        # ChannelTimeoutError leaves the ring consistent and the caller can
+        # simply retry (compiled_dag relies on this).
         with self._lock:
             slot = self._slots[self._w % len(self._slots)]
-            self._w += 1
         slot.write(value, timeout)
+        with self._lock:
+            self._w += 1
 
     def read(self, reader_id: int = 0, timeout: Optional[float] = None):
         with self._lock:
             slot = self._slots[self._r[reader_id] % len(self._slots)]
+        value = slot.read(reader_id, timeout)
+        with self._lock:
             self._r[reader_id] += 1
-        return slot.read(reader_id, timeout)
+        return value
 
     def close(self):
         for s in self._slots:

@@ -1,0 +1,47 @@
+"""Collective communication API (reference role: python/ray/util/collective).
+
+The reference wraps NCCL/Gloo process groups created between actors
+(init_collective_group / allreduce / ... [unverified]). TPU-native, there
+are two planes:
+
+- **In-program** (the fast path): collectives are XLA ops on mesh axes —
+  ``ray_tpu.collective.allreduce(x, axis="dp")`` inside shard_map/jit
+  compiles to an ICI collective. These are thin aliases over jax.lax so
+  user code written against the reference API shape ports directly.
+- **Out-of-program** (actor plane): named groups of actors exchanging host
+  arrays, matching the reference's group management semantics
+  (declare_collective_group, rank/world_size) with a CPU reduction — the
+  control-plane analogue of its Gloo backend.
+"""
+
+from ray_tpu.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective import ops
+
+__all__ = [
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_rank",
+    "get_collective_group_size",
+    "init_collective_group",
+    "ops",
+    "recv",
+    "reducescatter",
+    "send",
+]

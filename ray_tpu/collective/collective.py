@@ -1,0 +1,212 @@
+"""Actor-plane collective groups (reference API-shape parity:
+init_collective_group / declare / allreduce between actors).
+
+Out-of-program collectives between ray_tpu actors: a named group with
+ranks, a rendezvous barrier, and CPU reductions over numpy arrays. This is
+the control-plane analogue of the reference's Gloo backend — the data plane
+for tensors should use in-program collectives (ray_tpu.collective.ops) which
+ride ICI.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_REDUCERS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+    "product": lambda arrs: np.prod(arrs, axis=0),
+}
+
+
+class _Group:
+    def __init__(self, world_size: int, name: str):
+        self.world_size = world_size
+        self.name = name
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._round = 0
+        self._contrib: Dict[int, Any] = {}
+        self._result: Any = None
+        self._p2p: Dict[tuple, Any] = {}
+        self._p2p_cv = threading.Condition()
+
+    def _collect(self, rank: int, value, combine, timeout: float):
+        """Rendezvous: all ranks contribute, one combines, all read."""
+        with self._cv:
+            my_round = self._round
+            self._contrib[rank] = value
+            if len(self._contrib) == self.world_size:
+                vals = [self._contrib[r] for r in range(self.world_size)]
+                self._result = combine(vals)
+                self._contrib = {}
+                self._round += 1
+                self._cv.notify_all()
+            else:
+                if not self._cv.wait_for(
+                        lambda: self._round > my_round, timeout=timeout):
+                    raise TimeoutError(
+                        f"collective on group {self.name!r}: only "
+                        f"{len(self._contrib)}/{self.world_size} ranks "
+                        f"arrived within {timeout}s")
+            return self._result
+
+    def send(self, value, src: int, dst: int):
+        with self._p2p_cv:
+            self._p2p[(src, dst)] = value
+            self._p2p_cv.notify_all()
+
+    def recv(self, src: int, dst: int, timeout: float):
+        with self._p2p_cv:
+            if not self._p2p_cv.wait_for(
+                    lambda: (src, dst) in self._p2p, timeout=timeout):
+                raise TimeoutError(f"recv({src}->{dst}) timed out")
+            return self._p2p.pop((src, dst))
+
+
+_groups: Dict[str, _Group] = {}
+_rank_of: Dict[tuple, int] = {}  # (group, thread-key) -> rank
+_lock = threading.Lock()
+_DEFAULT_TIMEOUT = 60.0
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "xla",
+                          group_name: str = "default") -> None:
+    """Join the calling worker to a named group (reference signature
+    parity; backend is advisory — 'xla' here, vs 'nccl'/'gloo' there)."""
+    with _lock:
+        g = _groups.get(group_name)
+        if g is None:
+            g = _Group(world_size, group_name)
+            _groups[group_name] = g
+        elif g.world_size != world_size:
+            raise ValueError(
+                f"group {group_name!r} exists with world_size "
+                f"{g.world_size} != {world_size}")
+    _set_rank(group_name, rank)
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int],
+                            backend: str = "xla",
+                            group_name: str = "default") -> None:
+    """Driver-side declaration (reference: declare_collective_group)."""
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must align")
+    refs = [
+        a._ray_tpu_collective_join.remote(world_size, r, backend, group_name)
+        if hasattr(a, "_ray_tpu_collective_join")
+        else _remote_join(a, world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    import ray_tpu
+
+    ray_tpu.get(refs)
+
+
+def _remote_join(actor, world_size, rank, backend, group_name):
+    # Fallback: call a conventional `collective_join` method if present.
+    return actor.collective_join.remote(world_size, rank, backend, group_name)
+
+
+def _set_rank(group_name: str, rank: int):
+    key = (group_name, threading.get_ident())
+    with _lock:
+        _rank_of[key] = rank
+
+
+def _my_rank(group_name: str) -> int:
+    key = (group_name, threading.get_ident())
+    with _lock:
+        if key not in _rank_of:
+            raise RuntimeError(
+                f"caller has not joined group {group_name!r}; call "
+                f"init_collective_group first")
+        return _rank_of[key]
+
+
+def _group(group_name: str) -> _Group:
+    with _lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(f"no collective group {group_name!r}")
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _my_rank(group_name)
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum",
+              timeout: float = _DEFAULT_TIMEOUT):
+    g = _group(group_name)
+    arr = np.asarray(tensor)
+    out = g._collect(_my_rank(group_name), arr,
+                     lambda vals: _REDUCERS[op](np.stack(vals)), timeout)
+    return np.array(out, copy=True)
+
+
+def allgather(tensor, group_name: str = "default",
+              timeout: float = _DEFAULT_TIMEOUT):
+    g = _group(group_name)
+    out = g._collect(_my_rank(group_name), np.asarray(tensor),
+                     lambda vals: [np.array(v, copy=True) for v in vals],
+                     timeout)
+    return list(out)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum",
+                  timeout: float = _DEFAULT_TIMEOUT):
+    g = _group(group_name)
+    rank = _my_rank(group_name)
+    arr = np.asarray(tensor)
+    if arr.shape[0] % g.world_size:
+        raise ValueError(
+            f"leading dim {arr.shape[0]} not divisible by world size "
+            f"{g.world_size}")
+    full = g._collect(rank, arr,
+                      lambda vals: _REDUCERS[op](np.stack(vals)), timeout)
+    chunk = full.shape[0] // g.world_size
+    return np.array(full[rank * chunk:(rank + 1) * chunk], copy=True)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout: float = _DEFAULT_TIMEOUT):
+    g = _group(group_name)
+    out = g._collect(_my_rank(group_name), np.asarray(tensor),
+                     lambda vals: vals[src_rank], timeout)
+    return np.array(out, copy=True)
+
+
+def barrier(group_name: str = "default", timeout: float = _DEFAULT_TIMEOUT):
+    g = _group(group_name)
+    g._collect(_my_rank(group_name), None, lambda vals: None, timeout)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    g.send(np.array(np.asarray(tensor), copy=True),
+           _my_rank(group_name), dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default",
+         timeout: float = _DEFAULT_TIMEOUT):
+    g = _group(group_name)
+    return g.recv(src_rank, _my_rank(group_name), timeout)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        _groups.pop(group_name, None)
+        for key in [k for k in _rank_of if k[0] == group_name]:
+            _rank_of.pop(key, None)

@@ -1,0 +1,69 @@
+"""In-program collectives: jax.lax aliases bound to mesh axis names.
+
+Use inside jit/shard_map; XLA lowers these to ICI collectives on TPU.
+Mirrors the reference's op surface (allreduce/allgather/reducescatter/
+broadcast/send-recv → psum/all_gather/psum_scatter/ppermute).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def allreduce(x, axis: AxisName, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x, axis: AxisName, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reducescatter(x, axis: AxisName, *, scatter_axis: int = 0,
+                  tiled: bool = True):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=tiled)
+
+
+def broadcast(x, axis: str, root: int = 0):
+    """Every shard gets the root shard's value (mask + psum: ppermute
+    forbids duplicated sources)."""
+    idx = lax.axis_index(axis)
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis)
+
+
+def permute(x, axis: str, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int,
+               *, tiled: bool = True):
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def send_recv(x, axis: str, src: int, dst: int):
+    """Point-to-point: dst receives src's value; everyone else keeps zeros
+    (ppermute semantics — the aDAG NCCL p2p analogue in-program)."""
+    return lax.ppermute(x, axis, [(src, dst)])
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

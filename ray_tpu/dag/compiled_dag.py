@@ -13,7 +13,9 @@ which fuses the whole graph into one XLA program instead.
 
 from __future__ import annotations
 
+import atexit
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.channels import BufferedChannel
@@ -26,9 +28,33 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
-from ray_tpu.exceptions import ChannelError, RayTaskError
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ChannelError,
+    ChannelTimeoutError,
+    RayTaskError,
+)
 
 _UNREAD = object()
+
+# Exec loops poll at this cadence so they can notice teardown; partial
+# stage progress is kept across poll timeouts, so polling never desyncs.
+_POLL_S = 0.5
+
+# Live DAGs are torn down at interpreter exit so exec loops hosted on
+# non-daemon actor threads (mailbox closures) can't hang process shutdown.
+_LIVE_DAGS: "weakref.WeakSet[CompiledDAG]" = weakref.WeakSet()
+
+
+def _teardown_all():
+    for dag in list(_LIVE_DAGS):
+        try:
+            dag.teardown()
+        except Exception:  # noqa: BLE001 — best-effort at exit
+            pass
+
+
+atexit.register(_teardown_all)
 
 
 class CompiledDAGRef:
@@ -37,36 +63,47 @@ class CompiledDAGRef:
     def __init__(self, dag: "CompiledDAG", index: int):
         self._dag = dag
         self._index = index
-        self._value: Any = None
-        self._resolved = False
 
     def get(self, timeout: Optional[float] = None):
         return self._dag._read_result(self._index, timeout)
 
 
 class _Stage:
-    """One executable node: read args from channels, run, write output."""
+    """One executable node: read args from channels, run, write output.
+
+    Partial progress (args read, value computed but not yet written)
+    survives a ChannelTimeoutError so ``run_once`` can simply be retried
+    without double-consuming channel versions.
+    """
 
     def __init__(self, node: DAGNode, fn, arg_sources: List[Tuple],
-                 out_channel: BufferedChannel):
+                 out_channel: BufferedChannel, method_name: str = ""):
         self.node = node
-        self.fn = fn
+        self.fn = fn  # None for actor stages: resolved against `instance`
+        self.method_name = method_name
         self.arg_sources = arg_sources  # (channel, reader_id) or ("const", v)
         self.out_channel = out_channel
+        self._args_cache = [_UNREAD] * len(arg_sources)
+        self._pending = _UNREAD
 
-    def run_once(self):
-        args = []
-        for kind, a, b in self.arg_sources:
-            if kind == "const":
-                args.append(a)
-            else:
-                args.append(a.read(b))
-        try:
-            value = self.fn(*args)
-        except Exception as exc:  # noqa: BLE001 — stage error boundary
-            value = RayTaskError.from_exception(
-                getattr(self.fn, "__name__", "stage"), exc)
-        self.out_channel.write(value)
+    def run_once(self, instance=None):
+        if self._pending is _UNREAD:
+            for i, (kind, a, b) in enumerate(self.arg_sources):
+                if self._args_cache[i] is _UNREAD:
+                    self._args_cache[i] = (
+                        a if kind == "const" else a.read(b, _POLL_S))
+            fn = self.fn if instance is None else getattr(
+                instance, self.method_name)
+            try:
+                value = fn(*self._args_cache)
+            except Exception as exc:  # noqa: BLE001 — stage error boundary
+                value = RayTaskError.from_exception(
+                    self.method_name or getattr(fn, "__name__", "stage"),
+                    exc)
+            self._pending = value
+            self._args_cache = [_UNREAD] * len(self.arg_sources)
+        self.out_channel.write(self._pending, _POLL_S)
+        self._pending = _UNREAD
 
 
 class CompiledDAG:
@@ -82,6 +119,7 @@ class CompiledDAG:
         self._torn_down = False
         self._build()
         self._partial = [_UNREAD] * len(self._out_sources)
+        _LIVE_DAGS.add(self)
 
     # ------------------------------------------------------------------ build
     def _build(self):
@@ -153,6 +191,7 @@ class CompiledDAG:
             if out_ch is None:
                 # Leaf with no consumers shouldn't happen (leaf counted).
                 out_ch = BufferedChannel(1, self._buffer)
+            method_name = ""
             if isinstance(node, FunctionNode):
                 fn = node.function
                 key = "__driver__"
@@ -168,13 +207,19 @@ class CompiledDAG:
             else:  # ClassMethodNode
                 method = node._bound_method()
                 runtime = method._runtime
-                if runtime._instance_ready is not None:
-                    runtime._instance_ready.wait(timeout=30)
-                instance = runtime.instance
-                fn = getattr(instance, method._method_name)
-                key = runtime.actor_id
+                if not runtime._instance_ready.wait(timeout=30):
+                    raise TimeoutError(
+                        f"actor {runtime.class_name!r} did not finish "
+                        f"__init__ within 30s during DAG compile")
+                if runtime.dead or runtime._init_error is not None:
+                    raise ActorDiedError(
+                        runtime.actor_id,
+                        runtime.death_cause or "actor died before compile")
+                fn = None  # resolved against the actor instance in-loop
+                method_name = method._method_name
+                key = runtime
             self._loops.setdefault(key, []).append(
-                _Stage(node, fn, arg_sources, out_ch))
+                _Stage(node, fn, arg_sources, out_ch, method_name))
 
         # Output readers (driver side).
         if isinstance(self._leaf, MultiOutputNode):
@@ -185,21 +230,39 @@ class CompiledDAG:
             self._out_sources = [_source_for(self._leaf)]
             self._multi_output = False
 
-        # Start loop threads: each iterates its stages in topo order.
+        # Start execution loops. Driver-side stages run on a dedicated
+        # thread; actor stages are submitted INTO the actor's mailbox as one
+        # long-running closure (reference do_exec_tasks parity) so they
+        # execute on the actor's own loop thread, serialized with — and
+        # blocking — normal .remote() calls until teardown.
         self._threads: List[threading.Thread] = []
         for key, stages in self._loops.items():
-            t = threading.Thread(
-                target=self._exec_loop, args=(stages,), daemon=True,
-                name=f"compiled-dag-loop-{key}")
-            t.start()
-            self._threads.append(t)
+            if key == "__driver__":
+                t = threading.Thread(
+                    target=self._exec_loop, args=(stages, None), daemon=True,
+                    name="compiled-dag-loop-driver")
+                t.start()
+                self._threads.append(t)
+            else:
+                key.submit_exec_loop(
+                    lambda instance, stages=stages:
+                    self._exec_loop(stages, instance))
 
-    def _exec_loop(self, stages: List[_Stage]):
-        """do_exec_tasks parity: run the static schedule until teardown."""
+    def _exec_loop(self, stages: List[_Stage], instance):
+        """do_exec_tasks parity: run the static schedule until teardown.
+
+        A timeout only means a producer/consumer is slow — retry the
+        schedule (stages keep partial progress); a closed channel means
+        teardown — exit.
+        """
         while True:
             try:
                 for stage in stages:
-                    stage.run_once()
+                    stage.run_once(instance)
+            except ChannelTimeoutError:
+                if self._torn_down:
+                    return
+                continue
             except ChannelError:
                 return
 
@@ -208,16 +271,18 @@ class CompiledDAG:
         if self._torn_down:
             raise ChannelError("compiled DAG has been torn down")
         # Index assignment and input write are atomic so concurrent
-        # execute() calls keep ref<->result order aligned.
+        # execute() calls keep ref<->result order aligned; the count only
+        # advances after a successful write, so a timed-out (backpressured)
+        # execute() leaves the ref<->result mapping intact.
         with self._lock:
-            index = self._exec_count
-            self._exec_count += 1
             if self._input_node is not None:
                 ch = self._channels.get(id(self._input_node))
                 if ch is not None:
                     value = (input_values[0] if len(input_values) == 1
                              else input_values)
                     ch.write(value)
+            index = self._exec_count
+            self._exec_count += 1
         return CompiledDAGRef(self, index)
 
     def _read_result(self, index: int, timeout: Optional[float]):

@@ -53,7 +53,7 @@ class DAGNode:
         return order
 
     # ------------------------------------------------------------- execute
-    def execute(self, *input_values, _visited=None) -> Any:
+    def execute(self, *input_values) -> Any:
         """Interpreted execution: submit as normal tasks, return ObjectRef
         (or raw input value for InputNode)."""
         cache: Dict[int, Any] = {}

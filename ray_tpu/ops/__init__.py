@@ -1,0 +1,16 @@
+"""TPU kernels (Pallas) for the framework's hot ops.
+
+The reference's hot kernels live in CUDA via torch; here they are Pallas
+TPU kernels with jax-level fallbacks. Kernels auto-fall back to the pure
+jax implementation off-TPU (CPU tests) or when shapes don't fit the TPU
+tiling constraints, so every call site is portable.
+"""
+
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.fused import rms_norm_fused, softmax_cross_entropy
+
+__all__ = [
+    "flash_attention",
+    "rms_norm_fused",
+    "softmax_cross_entropy",
+]

@@ -1,0 +1,126 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise attention with online softmax: the [S, S] score matrix never
+materializes in HBM — each (q-block, k-block) tile of scores lives in VMEM,
+feeding the MXU with [block, head_dim] @ [head_dim, block] matmuls while
+running max/sum accumulators carry the normalization (same recurrence the
+ring_attention layer uses across chips; this kernel is the within-chip
+block loop).
+
+Grid: (batch*heads, num_q_blocks); the k-loop runs inside the kernel via
+fori_loop over VMEM blocks. Falls back to a pure-jax implementation on
+non-TPU backends or awkward shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _fallback(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
+                 causal: bool, scale: float, block_q: int):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...] * scale                      # [block_q, d]
+    qi = pl.program_id(1)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[pl.dslice(kb * block_k, block_k), :]     # [block_k, d]
+        v = v_ref[pl.dslice(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jnp.arange(block_q)
+            k_pos = kb * block_k + jnp.arange(block_k)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    if causal:
+        # Only k-blocks at or before this q-block contribute.
+        last = (qi + 1) * block_q
+        num_needed = (last + block_k - 1) // block_k
+        num_kb_run = jnp.minimum(num_kb, num_needed)
+    else:
+        num_kb_run = num_kb
+    m, l, acc = lax.fori_loop(0, num_kb_run, body, (m, l, acc))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """q/k/v: [B, H, S, D] -> [B, H, S, D]. GQA: repeat kv heads first."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if interpret is None:
+        interpret = not on_tpu
+    # Tiling constraints: block divisibility and lane-width-friendly D.
+    if (Sq % min(block_q, Sq) or Sk % min(block_k, Sk)
+            or Sq < 8 or Sk < 8 or D % 8):
+        return _fallback(q, k, v, causal, scale)
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+
+    from jax.experimental import pallas as pl
+
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, seq_k=Sk, causal=causal,
+        scale=scale, block_q=block_q)
+
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sq, D)
