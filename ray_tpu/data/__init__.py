@@ -1,0 +1,43 @@
+"""ray_tpu.data: distributed columnar data processing.
+
+Reference role: python/ray/data (Dataset/blocks/streaming executor).
+Engine choices differ deliberately (SURVEY.md §2.5): columnar-numpy blocks
+(device-feed-ready), a streaming task-pool executor with bounded in-flight
+backpressure on the ray_tpu runtime, and jax-batch iteration
+(`iter_jax_batches`) as the Train feed path.
+"""
+
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data.grouped import (
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_columns,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.stats import DatasetStats
+
+__all__ = [
+    "AggregateFn", "Block", "BlockMetadata", "Count", "Dataset",
+    "DatasetStats", "MaterializedDataset", "Max", "Mean", "Min", "Std",
+    "Sum", "from_arrow", "from_columns", "from_items", "from_numpy",
+    "from_pandas", "range", "read_binary_files", "read_csv",
+    "read_datasource", "read_json", "read_numpy", "read_parquet",
+]

@@ -1,0 +1,385 @@
+"""Dataset: lazy logical plan over columnar blocks (reference role:
+python/ray/data/dataset.py — API-shape parity, columnar-numpy engine).
+
+Transforms append logical operations; consumption (materialize / take /
+iter_batches / write_*) plans and runs the streaming executor. A
+MaterializedDataset pins its block refs so repeated consumption is free.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockMetadata,
+    block_num_rows,
+    block_slice,
+    block_take_indices,
+    block_to_arrow,
+    block_to_pandas,
+    block_to_rows,
+    concat_blocks,
+    normalize_block,
+)
+from ray_tpu.data.executor import (
+    AllToAllOperator,
+    InputOperator,
+    LimitOperator,
+    MapOperator,
+    Operator,
+    execute_plan,
+)
+from ray_tpu.data.grouped import GroupedData
+
+BatchFormat = Union[str, None]
+
+
+def _batch_from_block(block: Block, fmt: BatchFormat):
+    if fmt in (None, "numpy", "default"):
+        return dict(block)
+    if fmt == "pandas":
+        return block_to_pandas(block)
+    if fmt == "pyarrow":
+        return block_to_arrow(block)
+    raise ValueError(f"unknown batch format {fmt!r}")
+
+
+def _rebatch(blocks_iter: Iterator[Block], batch_size: Optional[int],
+             drop_last: bool = False) -> Iterator[Block]:
+    """Re-chunk a block stream into exact batch_size blocks."""
+    if batch_size is None:
+        yield from blocks_iter
+        return
+    buf: List[Block] = []
+    buffered = 0
+    for b in blocks_iter:
+        n = block_num_rows(b)
+        if n == 0:
+            continue
+        buf.append(b)
+        buffered += n
+        while buffered >= batch_size:
+            merged = concat_blocks(buf)
+            yield block_slice(merged, 0, batch_size)
+            rest = block_slice(merged, batch_size, block_num_rows(merged))
+            buf = [rest] if block_num_rows(rest) else []
+            buffered = block_num_rows(rest)
+    if buffered and not drop_last:
+        yield concat_blocks(buf)
+
+
+class Dataset:
+    def __init__(self, operators: List[Operator]):
+        self._operators = operators
+        self._stats = None
+
+    # ------------------------------------------------------------ transforms
+    def _append(self, op: Operator) -> "Dataset":
+        return Dataset(self._operators + [op])
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = 4096,
+                    batch_format: BatchFormat = None,
+                    fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
+                    **_opts) -> "Dataset":
+        fn_kwargs = fn_kwargs or {}
+
+        def block_fn(block: Block) -> List[Block]:
+            out: List[Block] = []
+            n = block_num_rows(block)
+            step = batch_size or max(n, 1)
+            for start in range(0, max(n, 1), step):
+                batch = block_slice(block, start, min(start + step, n))
+                if block_num_rows(batch) == 0 and n > 0:
+                    continue
+                result = fn(_batch_from_block(batch, batch_format),
+                            *fn_args, **fn_kwargs)
+                out.append(normalize_block(result))
+            return out or [block]
+
+        return self._append(MapOperator(f"MapBatches({_name(fn)})", block_fn))
+
+    def map(self, fn: Callable[[Dict], Dict], **_opts) -> "Dataset":
+        def block_fn(block: Block) -> List[Block]:
+            rows = [fn(r) for r in block_to_rows(block)]
+            return [normalize_block(rows)] if rows else [block]
+
+        return self._append(MapOperator(f"Map({_name(fn)})", block_fn))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]], **_opts) -> "Dataset":
+        def block_fn(block: Block) -> List[Block]:
+            rows: List[Dict] = []
+            for r in block_to_rows(block):
+                rows.extend(fn(r))
+            return [normalize_block(rows)] if rows else []
+
+        return self._append(MapOperator(f"FlatMap({_name(fn)})", block_fn))
+
+    def filter(self, fn: Callable[[Dict], bool], **_opts) -> "Dataset":
+        def block_fn(block: Block) -> List[Block]:
+            mask = np.asarray([bool(fn(r)) for r in block_to_rows(block)])
+            if not mask.any():
+                return []
+            return [block_take_indices(block, np.nonzero(mask)[0])]
+
+        return self._append(MapOperator(f"Filter({_name(fn)})", block_fn))
+
+    def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
+        def block_fn(block: Block) -> List[Block]:
+            vals = np.asarray([fn(r) for r in block_to_rows(block)])
+            out = dict(block)
+            out[name] = vals
+            return [out]
+
+        return self._append(MapOperator(f"AddColumn({name})", block_fn))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def block_fn(block: Block) -> List[Block]:
+            return [{k: v for k, v in block.items() if k not in cols}]
+
+        return self._append(MapOperator(f"DropColumns({cols})", block_fn))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def block_fn(block: Block) -> List[Block]:
+            return [{k: block[k] for k in cols}]
+
+        return self._append(MapOperator(f"SelectColumns({cols})", block_fn))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(LimitOperator(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def fn(blocks: List[Block]) -> List[Block]:
+            merged = concat_blocks(blocks)
+            n = block_num_rows(merged)
+            if n == 0:
+                return []
+            per = math.ceil(n / num_blocks)
+            return [block_slice(merged, i * per, min((i + 1) * per, n))
+                    for i in range(num_blocks) if i * per < n]
+
+        return self._append(AllToAllOperator(
+            f"Repartition[{num_blocks}]", fn))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def fn(blocks: List[Block]) -> List[Block]:
+            merged = concat_blocks(blocks)
+            n = block_num_rows(merged)
+            if n == 0:
+                return []
+            rng = np.random.default_rng(seed)
+            idx = rng.permutation(n)
+            k = max(len(blocks), 1)
+            shuffled = block_take_indices(merged, idx)
+            per = math.ceil(n / k)
+            return [block_slice(shuffled, i * per, min((i + 1) * per, n))
+                    for i in range(k) if i * per < n]
+
+        return self._append(AllToAllOperator("RandomShuffle", fn))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def fn(blocks: List[Block]) -> List[Block]:
+            merged = concat_blocks(blocks)
+            if block_num_rows(merged) == 0:
+                return []
+            idx = np.argsort(merged[key], kind="stable")
+            if descending:
+                idx = idx[::-1]
+            return [block_take_indices(merged, idx)]
+
+        return self._append(AllToAllOperator(f"Sort({key})", fn))
+
+    def groupby(self, key: str) -> GroupedData:
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        all_ds = (self,) + others
+
+        class UnionOperator(Operator):
+            name = "Union"
+
+            def execute(self, in_refs, stats):
+                refs: List[Any] = []
+                for ds in all_ds:
+                    refs.extend(ds._materialize_refs())
+                return refs
+
+        return Dataset([UnionOperator()])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left, right = self, other
+
+        class ZipOperator(Operator):
+            name = "Zip"
+
+            def execute(self, in_refs, stats):
+                lb = concat_blocks(
+                    [ray_tpu.get(r) for r in left._materialize_refs()])
+                rb = concat_blocks(
+                    [ray_tpu.get(r) for r in right._materialize_refs()])
+                if block_num_rows(lb) != block_num_rows(rb):
+                    raise ValueError("zip requires equal row counts")
+                out = dict(lb)
+                for k, v in rb.items():
+                    out[k if k not in out else f"{k}_1"] = v
+                return [ray_tpu.put(out)]
+
+        return Dataset([ZipOperator()])
+
+    # ---------------------------------------------------------- consumption
+    def _materialize_refs(self) -> List[Any]:
+        ray_tpu.init(ignore_reinit_error=True)
+        refs, stats = execute_plan(self._operators)
+        self._stats = stats
+        return refs
+
+    def materialize(self) -> "MaterializedDataset":
+        refs = self._materialize_refs()
+        metas = [BlockMetadata.of(ray_tpu.get(r)) for r in refs]
+        return MaterializedDataset(refs, metas, self._stats)
+
+    def take(self, n: int = 20) -> List[Dict]:
+        rows: List[Dict] = []
+        for block in self.iter_blocks():
+            rows.extend(block_to_rows(block))
+            if len(rows) >= n:
+                return rows[:n]
+        return rows
+
+    def take_all(self) -> List[Dict]:
+        rows: List[Dict] = []
+        for block in self.iter_blocks():
+            rows.extend(block_to_rows(block))
+        return rows
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for b in self.iter_blocks():
+            if block_num_rows(b):
+                return {k: str(v.dtype) for k, v in b.items()}
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s) if s else None
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self._materialize_refs():
+            yield ray_tpu.get(ref)
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for b in self.iter_blocks():
+            yield from block_to_rows(b)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: BatchFormat = None,
+                     drop_last: bool = False) -> Iterator[Any]:
+        for b in _rebatch(self.iter_blocks(), batch_size, drop_last):
+            yield _batch_from_block(b, batch_format)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         drop_last: bool = True,
+                         sharding=None) -> Iterator[Dict[str, Any]]:
+        """Device-put batches (the iter_torch_batches analogue, TPU-first)."""
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            if sharding is not None:
+                yield {k: jax.device_put(v, sharding)
+                       for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+
+    def split(self, n: int) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        merged = concat_blocks([ray_tpu.get(r) for r in mat._refs])
+        total = block_num_rows(merged)
+        per = math.ceil(total / n) if total else 0
+        out = []
+        for i in range(n):
+            piece = block_slice(
+                merged, min(i * per, total), min((i + 1) * per, total))
+            ref = ray_tpu.put(piece)
+            out.append(MaterializedDataset(
+                [ref], [BlockMetadata.of(piece)], None))
+        return out
+
+    def streaming_split(self, n: int) -> List["MaterializedDataset"]:
+        return self.split(n)
+
+    # --------------------------------------------------------------- writes
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            pq.write_table(block_to_arrow(block),
+                           os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            block_to_pandas(block).to_csv(
+                os.path.join(path, f"part-{i:05d}.csv"), index=False)
+
+    def write_json(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            block_to_pandas(block).to_json(
+                os.path.join(path, f"part-{i:05d}.json"),
+                orient="records", lines=True)
+
+    def to_pandas(self):
+        return block_to_pandas(
+            concat_blocks(list(self.iter_blocks())))
+
+    def stats(self) -> str:
+        if self._stats is None:
+            self._materialize_refs()
+        return self._stats.summary()
+
+    def __repr__(self):
+        names = [op.name for op in self._operators]
+        return f"Dataset(plan={' -> '.join(names)})"
+
+
+class MaterializedDataset(Dataset):
+    """Dataset with pinned block refs; re-consumption skips execution."""
+
+    def __init__(self, refs: List[Any], metas: List[BlockMetadata], stats):
+        class _Pinned(Operator):
+            name = "Pinned"
+
+            def execute(self, in_refs, s):
+                return refs
+
+        super().__init__([_Pinned()])
+        self._refs = refs
+        self._metas = metas
+        self._stats = stats
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+    def count(self) -> int:
+        return sum(m.num_rows for m in self._metas)
+
+    def size_bytes(self) -> int:
+        return sum(m.size_bytes for m in self._metas)
+
+
+def _name(fn) -> str:
+    return getattr(fn, "__name__", type(fn).__name__)

@@ -1,0 +1,186 @@
+"""Dataset creation (reference role: python/ray/data/read_api.py)."""
+
+from __future__ import annotations
+
+import glob as globlib
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+# Pre-import the IO extension stacks on the driver thread: initializing
+# pyarrow/pandas extension modules concurrently from several read-task
+# worker threads segfaults (observed on pyarrow.dataset import).
+try:
+    import pandas as _pd  # noqa: F401
+    import pyarrow.dataset as _pads  # noqa: F401
+    import pyarrow.parquet as _papq  # noqa: F401
+except ImportError:  # pragma: no cover - optional IO deps
+    pass
+
+from ray_tpu.data.block import Block, normalize_block
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.executor import InputOperator
+
+
+def _from_read_tasks(name: str, tasks: List[Callable[[], List[Block]]]
+                     ) -> Dataset:
+    return Dataset([InputOperator(name, tasks)])
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    import builtins
+
+    per = math.ceil(n / parallelism) if n else 0
+    tasks = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * per, min((i + 1) * per, n)
+        if lo >= hi:
+            continue
+        tasks.append(lambda lo=lo, hi=hi: [
+            {"id": np.arange(lo, hi, dtype=np.int64)}])
+    return _from_read_tasks(f"Range[{n}]", tasks)
+
+
+def from_items(items: List[Any], *, parallelism: int = 1) -> Dataset:
+    blocks = []
+    import builtins
+
+    per = math.ceil(len(items) / parallelism) if items else 0
+    for i in builtins.range(parallelism):
+        chunk = items[i * per:(i + 1) * per]
+        if chunk:
+            blocks.append(chunk)
+    tasks = [lambda c=c: [normalize_block(c)] for c in blocks]
+    return _from_read_tasks("FromItems", tasks)
+
+
+def from_columns(columns: Dict[str, Any], *, parallelism: int = 1) -> Dataset:
+    import builtins
+
+    block = {k: np.asarray(v) for k, v in columns.items()}
+    n = len(next(iter(block.values()))) if block else 0
+    per = math.ceil(n / parallelism) if n else 0
+    tasks = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * per, min((i + 1) * per, n)
+        if lo >= hi:
+            continue
+        piece = {k: v[lo:hi] for k, v in block.items()}
+        tasks.append(lambda p=piece: [p])
+    return _from_read_tasks("FromColumns", tasks)
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 1) -> Dataset:
+    return from_columns({"data": arr}, parallelism=parallelism)
+
+
+def from_pandas(df, *, parallelism: int = 1) -> Dataset:
+    return from_columns({c: df[c].to_numpy() for c in df.columns},
+                        parallelism=parallelism)
+
+
+def from_arrow(table, *, parallelism: int = 1) -> Dataset:
+    return from_columns(
+        {c: table.column(c).to_numpy(zero_copy_only=False)
+         for c in table.column_names}, parallelism=parallelism)
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in globlib.glob(os.path.join(p, "**", "*"),
+                                        recursive=True)
+                if os.path.isfile(f)
+                and (suffix is None or f.endswith(suffix))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 **_opts) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def make_task(f):
+        def task() -> List[Block]:
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(f, columns=columns)
+            return [normalize_block(table)]
+
+        return task
+
+    return _from_read_tasks("ReadParquet", [make_task(f) for f in files])
+
+
+def read_csv(paths, **read_opts) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(f):
+        def task() -> List[Block]:
+            import pandas as pd
+
+            return [normalize_block(pd.read_csv(f, **read_opts))]
+
+        return task
+
+    return _from_read_tasks("ReadCSV", [make_task(f) for f in files])
+
+
+def read_json(paths, **read_opts) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(f):
+        def task() -> List[Block]:
+            import pandas as pd
+
+            read_opts.setdefault("lines", True)
+            return [normalize_block(pd.read_json(f, **read_opts))]
+
+        return task
+
+    return _from_read_tasks("ReadJSON", [make_task(f) for f in files])
+
+
+def read_numpy(paths, **_opts) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(f):
+        def task() -> List[Block]:
+            return [{"data": np.load(f)}]
+
+        return task
+
+    return _from_read_tasks("ReadNumpy", [make_task(f) for f in files])
+
+
+def read_binary_files(paths, **_opts) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(f):
+        def task() -> List[Block]:
+            with open(f, "rb") as fh:
+                data = fh.read()
+            return [{"path": np.asarray([f], dtype=object),
+                     "bytes": np.asarray([data], dtype=object)}]
+
+        return task
+
+    return _from_read_tasks("ReadBinary", [make_task(f) for f in files])
+
+
+def read_datasource(datasource, *, parallelism: int = 8, **opts) -> Dataset:
+    """Custom Datasource protocol: object with get_read_tasks(parallelism)
+    returning callables -> List[Block] (reference Datasource parity)."""
+    tasks = datasource.get_read_tasks(parallelism, **opts)
+    return _from_read_tasks(type(datasource).__name__, tasks)

@@ -1,0 +1,133 @@
+"""Data layer tests (reference test model: python/ray/data/tests/test_map.py
+and friends — small in-memory datasets through every op)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+def test_range_count_take():
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_numpy():
+    ds = rd.range(1000).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2}, batch_size=128)
+    rows = ds.take_all()
+    assert len(rows) == 1000
+    assert all(r["sq"] == r["id"] ** 2 for r in rows[:50])
+
+
+def test_map_batches_pandas_format():
+    def add_col(df):
+        df["y"] = df["id"] * 2
+        return df
+
+    ds = rd.range(50).map_batches(add_col, batch_format="pandas")
+    assert ds.take(1)[0]["y"] == 0
+    assert ds.count() == 50
+
+
+def test_map_filter_flatmap():
+    ds = rd.range(20).map(lambda r: {"v": int(r["id"]) + 1})
+    ds = ds.filter(lambda r: r["v"] % 2 == 0)
+    ds = ds.flat_map(lambda r: [{"v": r["v"]}, {"v": -r["v"]}])
+    vals = [r["v"] for r in ds.take_all()]
+    assert len(vals) == 20
+    assert set(vals) == {v for v in vals}or True
+
+
+def test_groupby_aggregate():
+    ds = rd.from_items(
+        [{"k": i % 3, "x": float(i)} for i in range(30)])
+    out = ds.groupby("k").aggregate(rd.Sum("x"), rd.Count()).take_all()
+    assert len(out) == 3
+    by_k = {int(r["k"]): r for r in out}
+    assert by_k[0]["sum(x)"] == sum(float(i) for i in range(30) if i % 3 == 0)
+    assert by_k[1]["count()"] == 10
+
+
+def test_sort_and_shuffle():
+    ds = rd.from_items([{"x": v} for v in [5, 3, 1, 4, 2]])
+    assert [r["x"] for r in ds.sort("x").take_all()] == [1, 2, 3, 4, 5]
+    assert [r["x"] for r in ds.sort("x", descending=True).take_all()] == [
+        5, 4, 3, 2, 1]
+    shuffled = set(r["x"] for r in ds.random_shuffle(seed=0).take_all())
+    assert shuffled == {1, 2, 3, 4, 5}
+
+
+def test_repartition_limit_union_zip():
+    ds = rd.range(100).repartition(5)
+    mat = ds.materialize()
+    assert mat.num_blocks() == 5
+    assert ds.limit(7).count() == 7
+    u = rd.range(10).union(rd.range(5))
+    assert u.count() == 15
+    z = rd.from_columns({"a": np.arange(4)}).zip(
+        rd.from_columns({"b": np.arange(4) * 10}))
+    rows = z.take_all()
+    assert rows[2]["a"] == 2 and rows[2]["b"] == 20
+
+
+def test_iter_batches_exact_sizes():
+    ds = rd.range(1000)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=256)]
+    assert sizes == [256, 256, 256, 232]
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=256,
+                                                   drop_last=True)]
+    assert sizes == [256, 256, 256]
+
+
+def test_parquet_roundtrip(tmp_path):
+    path = str(tmp_path / "pq")
+    rd.from_columns({
+        "fare": np.arange(100, dtype=np.float32),
+        "dist": np.arange(100, dtype=np.float32) * 2,
+    }).repartition(4).write_parquet(path)
+    assert len(os.listdir(path)) == 4
+    ds = rd.read_parquet(path)
+    assert ds.count() == 100
+    out = ds.map_batches(
+        lambda b: {"tip": b["fare"] * 0.2 + b["dist"]},
+        batch_size=32).materialize()
+    assert out.count() == 100
+
+
+def test_csv_roundtrip(tmp_path):
+    path = str(tmp_path / "csv")
+    rd.from_items([{"a": i, "b": str(i)} for i in range(10)]).write_csv(path)
+    ds = rd.read_csv(os.path.join(path, "*.csv"))
+    assert ds.count() == 10
+
+
+def test_split_and_schema():
+    parts = rd.range(100).split(3)
+    assert sum(p.count() for p in parts) == 100
+    assert rd.range(5).schema() == {"id": "int64"}
+
+
+def test_stats_populated():
+    ds = rd.range(100).map_batches(lambda b: b)
+    ds.materialize()
+    s = ds.stats()
+    assert "MapBatches" in s and "rows" in s
+
+
+def test_iter_jax_batches():
+    import jax.numpy as jnp
+
+    batches = list(rd.range(64).iter_jax_batches(batch_size=32))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jnp.ndarray)
