@@ -1,0 +1,174 @@
+"""Algorithm orchestration (reference role: rllib/algorithms/algorithm.py +
+algorithm_config.py builder pattern)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import CartPole, JaxEnv, Pendulum
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.ppo import PPOConfig, PPOLearner
+
+_ENVS = {"CartPole-v1": CartPole, "Pendulum-v1": Pendulum}
+
+
+class AlgorithmConfig:
+    """Builder: config.environment(...).env_runners(...).training(...)."""
+
+    def __init__(self, algo: str = "PPO"):
+        self.algo = algo
+        self.env_name = "CartPole-v1"
+        self.env_factory = None
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 64
+        self.rollout_len = 128
+        self.train_config = PPOConfig()
+        self.seed = 0
+
+    def environment(self, env: str = None, *, env_factory=None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env_name = env
+        if env_factory is not None:
+            self.env_factory = env_factory
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 0,
+                    num_envs_per_env_runner: int = 64,
+                    rollout_fragment_length: int = 128
+                    ) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        import dataclasses
+
+        self.train_config = dataclasses.replace(self.train_config, **kw)
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "Algorithm":
+        return Algorithm(self)
+
+    # reference alias
+    build_algo = build
+
+
+class Algorithm:
+    """PPO training loop over (possibly remote) env runners."""
+
+    def __init__(self, config: AlgorithmConfig):
+        if config.algo != "PPO":
+            raise NotImplementedError(
+                f"algorithm {config.algo!r}; PPO is implemented natively — "
+                f"add algorithms via PPOLearner-style Learner classes")
+        self.config = config
+        factory = config.env_factory or _ENVS.get(config.env_name)
+        if factory is None:
+            raise ValueError(
+                f"unknown env {config.env_name!r}; pass env_factory or one "
+                f"of {list(_ENVS)}")
+        self.env: JaxEnv = factory()
+        self.learner = PPOLearner(self.env, config.train_config,
+                                  config.seed)
+        if config.num_env_runners > 0:
+            ray_tpu.init(ignore_reinit_error=True)
+            self._runners = [
+                EnvRunner.as_actor(self.env, config.num_envs_per_runner,
+                                   config.rollout_len, seed=config.seed + i)
+                for i in range(config.num_env_runners)
+            ]
+        else:
+            self._runners = [EnvRunner(
+                self.env, config.num_envs_per_runner, config.rollout_len,
+                seed=config.seed)]
+        self._iter = 0
+        self._key = jax.random.PRNGKey(config.seed + 777)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        params = self.learner.get_weights()
+        if self.config.num_env_runners > 0:
+            rollouts = ray_tpu.get(
+                [r.sample.remote(jax.device_get(params))
+                 for r in self._runners])
+        else:
+            rollouts = [r.sample(params) for r in self._runners]
+        sample_time = time.perf_counter() - t0
+
+        losses = []
+        total_steps = 0
+        ep_return = []
+        for ro in rollouts:
+            ro = jax.tree.map(jnp.asarray, ro)
+            self._key, k = jax.random.split(self._key)
+            losses.append(self.learner.update(ro, k))
+            total_steps += int(np.prod(np.asarray(ro.actions.shape)))
+            # Mean episode length proxy: 1/done-rate (auto-reset envs).
+            done_rate = float(jnp.mean(ro.dones.astype(jnp.float32)))
+            if done_rate > 0:
+                ep_return.append(1.0 / done_rate)
+        self._iter += 1
+        wall = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iter,
+            "loss": float(np.mean(losses)),
+            "num_env_steps_sampled": total_steps,
+            "env_steps_per_sec": total_steps / wall,
+            "sample_time_s": sample_time,
+            "episode_len_mean": float(np.mean(ep_return)) if ep_return
+            else float("nan"),
+            "time_total_s": wall,
+        }
+
+    def evaluate(self, num_episodes: int = 8) -> Dict[str, float]:
+        """Greedy policy evaluation: mean undiscounted return."""
+        from ray_tpu.rl.ppo import policy_logits
+
+        env = self.env
+        params = self.learner.get_weights()
+        key = jax.random.PRNGKey(123)
+
+        @jax.jit
+        def run_one(key):
+            (state, obs) = env.reset(key)
+
+            def step(carry):
+                state, obs, ret, done, key = carry
+                logits = policy_logits(params, obs[None])[0]
+                action = jnp.argmax(logits)
+                key, k = jax.random.split(key)
+                state, obs, r, d = env.step(state, action, k)
+                ret = ret + r * (1.0 - done)
+                return state, obs, ret, jnp.maximum(done, d.astype(
+                    jnp.float32)), key
+
+            def cond(carry):
+                _, _, _, done, _ = carry
+                return done < 0.5
+
+            _, _, ret, _, _ = jax.lax.while_loop(
+                cond, lambda c: step(c),
+                (state, obs, jnp.zeros(()), jnp.zeros(()), key))
+            return ret
+
+        rets = [float(run_one(k))
+                for k in jax.random.split(key, num_episodes)]
+        return {"episode_return_mean": float(np.mean(rets))}
+
+    def get_policy_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        self._runners = []

@@ -1,0 +1,36 @@
+"""RL rollout benchmark (BASELINE.json config #5: PPO rollout collection,
+CartPole-v1, 64 vectorized envs)."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def rollout_throughput(num_envs: int = 64, rollout_len: int = 512,
+                       n_iters: int = 5) -> dict:
+    from ray_tpu.rl.env import CartPole
+    from ray_tpu.rl.env_runner import EnvRunner
+    from ray_tpu.rl.ppo import PPOLearner
+
+    env = CartPole()
+    learner = PPOLearner(env)
+    runner = EnvRunner(env, num_envs=num_envs, rollout_len=rollout_len)
+    params = learner.get_weights()
+    # Warmup/compile.
+    ro = runner.sample(params)
+    jax.block_until_ready(ro.rewards)
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        ro = runner.sample(params)
+    jax.block_until_ready(ro.rewards)
+    dt = (time.perf_counter() - t0) / n_iters
+    steps = runner.steps_per_sample()
+    return {
+        "suite": "rl_rollout",
+        "env_steps_per_sec": steps / dt,
+        "num_envs": num_envs,
+        "rollout_len": rollout_len,
+        "wall_s_per_rollout": dt,
+    }

@@ -1,0 +1,124 @@
+"""Jax-native environments (reference role: rllib env/ + gymnasium).
+
+A JaxEnv is a pair of pure functions (reset, step) over an explicit state
+pytree — vmap gives vectorization, jit+scan gives whole-rollout fusion on
+TPU. Classic-control dynamics (CartPole, Pendulum) are implemented from
+their standard physics equations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxEnv:
+    """reset(key) -> (state, obs); step(state, action, key) ->
+    (state, obs, reward, done)."""
+
+    reset: Callable[[jax.Array], Tuple[Any, jax.Array]]
+    step: Callable[[Any, jax.Array, jax.Array], Tuple[Any, jax.Array,
+                                                      jax.Array, jax.Array]]
+    obs_dim: int
+    num_actions: int  # 0 => continuous (action_dim = abs value)
+    max_episode_steps: int
+
+
+def CartPole(max_episode_steps: int = 500) -> JaxEnv:
+    """CartPole-v1 dynamics (pole-balancing; standard constants)."""
+    gravity = 9.8
+    masscart, masspole = 1.0, 0.1
+    total_mass = masscart + masspole
+    length = 0.5
+    polemass_length = masspole * length
+    force_mag = 10.0
+    tau = 0.02
+    theta_lim = 12 * 2 * jnp.pi / 360
+    x_lim = 2.4
+
+    def reset(key):
+        s = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        t = jnp.zeros((), jnp.int32)
+        return (s, t), s
+
+    def step(state, action, key):
+        s, t = state
+        x, x_dot, theta, theta_dot = s
+        force = jnp.where(action == 1, force_mag, -force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sintheta
+                ) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        s2 = jnp.stack([x, x_dot, theta, theta_dot])
+        t2 = t + 1
+        done = ((jnp.abs(x) > x_lim) | (jnp.abs(theta) > theta_lim)
+                | (t2 >= max_episode_steps))
+        # Auto-reset on done (vectorized-env semantics).
+        (s_reset, t_reset), _ = reset(key)
+        s_next = jnp.where(done, s_reset, s2)
+        t_next = jnp.where(done, t_reset, t2)
+        return (s_next, t_next), s_next, jnp.ones(()), done
+
+    return JaxEnv(reset=reset, step=step, obs_dim=4, num_actions=2,
+                  max_episode_steps=max_episode_steps)
+
+
+def Pendulum(max_episode_steps: int = 200) -> JaxEnv:
+    """Pendulum-v1 dynamics (continuous torque control)."""
+    max_speed, max_torque = 8.0, 2.0
+    dt, g, m, l = 0.05, 10.0, 1.0, 1.0
+
+    def obs_of(s):
+        th, thdot = s
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(key):
+        k1, k2 = jax.random.split(key)
+        th = jax.random.uniform(k1, minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(k2, minval=-1.0, maxval=1.0)
+        s = jnp.stack([th, thdot])
+        t = jnp.zeros((), jnp.int32)
+        return (s, t), obs_of(s)
+
+    def step(state, action, key):
+        s, t = state
+        th, thdot = s
+        u = jnp.clip(action.reshape(()), -max_torque, max_torque)
+        angle = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = angle**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot2 = jnp.clip(
+            thdot + (3 * g / (2 * l) * jnp.sin(th)
+                     + 3.0 / (m * l**2) * u) * dt,
+            -max_speed, max_speed)
+        th2 = th + thdot2 * dt
+        s2 = jnp.stack([th2, thdot2])
+        t2 = t + 1
+        done = t2 >= max_episode_steps
+        (s_reset, t_reset), _ = reset(key)
+        s_next = jnp.where(done, s_reset, s2)
+        t_next = jnp.where(done, t_reset, t2)
+        return (s_next, t_next), obs_of(s_next), -cost, done
+
+    return JaxEnv(reset=reset, step=step, obs_dim=3, num_actions=0,
+                  max_episode_steps=max_episode_steps)
+
+
+def gym_adapter(env_name: str, **kw) -> JaxEnv:
+    """Wrap a gymnasium env id when the dynamics aren't jax-native.
+
+    Host-loop fallback — steps run via io_callback, so rollouts are not
+    fused; prefer the jax-native envs for throughput.
+    """
+    raise NotImplementedError(
+        "gymnasium adapter lands with the host-executor escape hatch; use "
+        "jax-native envs (CartPole/Pendulum) or implement JaxEnv directly")

@@ -1,0 +1,105 @@
+"""EnvRunner: vectorized rollout collection (reference role:
+rllib/env/single_agent_env_runner.py).
+
+The reference steps N gymnasium envs in a Python loop per runner actor;
+here the N envs, the policy forward, and the value bootstrap are fused into
+ONE jitted lax.scan over T steps — rollout collection is a single device
+program (the whole-program-fusion move this framework exists for). Wrap in
+a ray_tpu actor for fleets (`EnvRunnerGroup`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rl.env import JaxEnv
+from ray_tpu.rl.ppo import Rollout, policy_logits, value_fn
+
+
+def make_rollout_fn(env: JaxEnv, rollout_len: int):
+    """(params, env_state, obs, key) -> (Rollout, env_state, obs, key),
+    fully jitted; env_state/obs are vectorized [N, ...]."""
+
+    def step_once(carry, key):
+        params, state, obs = carry
+        k_act, k_env = jax.random.split(key)
+        logits = policy_logits(params, obs)              # [N, A]
+        action = jax.random.categorical(k_act, logits)   # [N]
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), action[:, None], -1)[:, 0]
+        value = value_fn(params, obs)
+        n = obs.shape[0]
+        state, obs_next, reward, done = jax.vmap(env.step)(
+            state, action, jax.random.split(k_env, n))
+        out = (obs, action, logp, reward, done, value)
+        return (params, state, obs_next), out
+
+    def rollout(params, state, obs, key):
+        keys = jax.random.split(key, rollout_len)
+        (params, state, obs_last), outs = jax.lax.scan(
+            step_once, (params, state, obs), keys)
+        obs_b, actions, logps, rewards, dones, values = outs
+        v_last = value_fn(params, obs_last)
+        values = jnp.concatenate([values, v_last[None]], axis=0)
+        return Rollout(obs_b, actions, logps, rewards, dones,
+                       values), state, obs_last
+
+    return jax.jit(rollout)
+
+
+class _EnvRunnerImpl:
+    def __init__(self, env: JaxEnv, num_envs: int, rollout_len: int,
+                 seed: int = 0):
+        self.env = env
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self._key = jax.random.PRNGKey(seed)
+        self._key, k = jax.random.split(self._key)
+        self.state, self.obs = jax.vmap(env.reset)(
+            jax.random.split(k, num_envs))
+        self._rollout = make_rollout_fn(env, rollout_len)
+
+    def sample(self, params) -> Rollout:
+        self._key, k = jax.random.split(self._key)
+        rollout, self.state, self.obs = self._rollout(
+            params, self.state, self.obs, k)
+        return rollout
+
+    def steps_per_sample(self) -> int:
+        return self.num_envs * self.rollout_len
+
+
+class EnvRunner:
+    """Local or actor-backed runner. Use ``EnvRunner.as_actor(...)`` for a
+    fleet of remote runners (EnvRunnerGroup parity)."""
+
+    def __init__(self, env: JaxEnv, num_envs: int = 64,
+                 rollout_len: int = 128, seed: int = 0):
+        self._impl = _EnvRunnerImpl(env, num_envs, rollout_len, seed)
+
+    def sample(self, params) -> Rollout:
+        return self._impl.sample(params)
+
+    def steps_per_sample(self) -> int:
+        return self._impl.steps_per_sample()
+
+    @staticmethod
+    def as_actor(env: JaxEnv, num_envs: int = 64, rollout_len: int = 128,
+                 seed: int = 0):
+        @ray_tpu.remote
+        class EnvRunnerActor:
+            def __init__(self):
+                self._impl = _EnvRunnerImpl(env, num_envs, rollout_len,
+                                            seed)
+
+            def sample(self, params):
+                return jax.device_get(self._impl.sample(params))
+
+            def steps_per_sample(self):
+                return self._impl.steps_per_sample()
+
+        return EnvRunnerActor.remote()

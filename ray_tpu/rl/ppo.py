@@ -1,0 +1,176 @@
+"""PPO learner (reference role: rllib/algorithms/ppo — clipped surrogate,
+GAE, entropy bonus), jax-native: the whole update (minibatch epochs
+included) is one jitted function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 4
+    num_minibatches: int = 4
+    max_grad_norm: float = 0.5
+
+
+class Rollout(NamedTuple):
+    obs: jax.Array        # [T, N, obs_dim]
+    actions: jax.Array    # [T, N]
+    log_probs: jax.Array  # [T, N]
+    rewards: jax.Array    # [T, N]
+    dones: jax.Array      # [T, N]
+    values: jax.Array     # [T+1, N]
+
+
+def init_policy(key, obs_dim: int, num_actions: int, hidden) -> Dict:
+    """Separate policy/value MLP towers, orthogonal-ish init."""
+    params = {}
+    for tower, out_dim in (("pi", max(num_actions, 1)), ("vf", 1)):
+        sizes = (obs_dim,) + tuple(hidden) + (out_dim,)
+        layers = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            scale = 0.01 if i == len(sizes) - 2 else jnp.sqrt(2.0 / a)
+            layers.append({
+                "w": jax.random.normal(k, (a, b)) * scale,
+                "b": jnp.zeros((b,)),
+            })
+        params[tower] = layers
+    return params
+
+
+def _mlp(layers, x):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def policy_logits(params, obs):
+    return _mlp(params["pi"], obs)
+
+
+def value_fn(params, obs):
+    return _mlp(params["vf"], obs)[..., 0]
+
+
+def gae_advantages(rewards, dones, values, gamma, lam):
+    """values: [T+1, N]; returns (advantages [T,N], targets [T,N])."""
+    def scan_fn(carry, inp):
+        r, d, v, v_next = inp
+        nonterm = 1.0 - d.astype(jnp.float32)
+        delta = r + gamma * v_next * nonterm - v
+        adv = delta + gamma * lam * nonterm * carry
+        return adv, adv
+
+    _, advs = lax.scan(
+        scan_fn, jnp.zeros_like(rewards[0]),
+        (rewards, dones, values[:-1], values[1:]), reverse=True)
+    return advs, advs + values[:-1]
+
+
+class PPOLearner:
+    """Owns params + optimizer; jitted update over a Rollout."""
+
+    def __init__(self, env, config: PPOConfig = PPOConfig(), seed: int = 0):
+        self.env = env
+        self.config = config
+        key = jax.random.PRNGKey(seed)
+        self.params = init_policy(
+            key, env.obs_dim, env.num_actions, config.hidden)
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            obs, actions, old_logp, advs, targets = batch
+            logits = policy_logits(params, obs)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), -1)[..., 0]
+            ratio = jnp.exp(logp - old_logp)
+            advs_n = (advs - advs.mean()) / (advs.std() + 1e-8)
+            pg = -jnp.minimum(
+                ratio * advs_n,
+                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * advs_n).mean()
+            v = value_fn(params, obs)
+            vf = jnp.mean((v - targets) ** 2)
+            ent = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+            return total, (pg, vf, ent)
+
+        def update(params, opt_state, rollout: Rollout, key):
+            advs, targets = gae_advantages(
+                rollout.rewards, rollout.dones, rollout.values,
+                cfg.gamma, cfg.gae_lambda)
+            T, N = rollout.actions.shape
+            flat = (
+                rollout.obs.reshape(T * N, -1),
+                rollout.actions.reshape(T * N),
+                rollout.log_probs.reshape(T * N),
+                advs.reshape(T * N),
+                targets.reshape(T * N),
+            )
+            B = T * N
+            mb = B // cfg.num_minibatches
+
+            def epoch(carry, ekey):
+                params, opt_state = carry
+                perm = jax.random.permutation(ekey, B)
+
+                def minibatch(carry, i):
+                    params, opt_state = carry
+                    idx = lax.dynamic_slice_in_dim(perm, i * mb, mb)
+                    batch = tuple(x[idx] for x in flat)
+                    (l, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, batch)
+                    updates, opt_state = self.opt.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), l
+
+                (params, opt_state), losses = lax.scan(
+                    minibatch, (params, opt_state),
+                    jnp.arange(cfg.num_minibatches))
+                return (params, opt_state), losses.mean()
+
+            (params, opt_state), losses = lax.scan(
+                epoch, (params, opt_state),
+                jax.random.split(key, cfg.num_epochs))
+            return params, opt_state, losses.mean()
+
+        return update
+
+    def update(self, rollout: Rollout, key) -> float:
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, rollout, key)
+        return float(loss)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
