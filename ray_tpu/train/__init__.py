@@ -1,0 +1,43 @@
+"""ray_tpu.train: distributed training orchestration.
+
+Reference role: python/ray/train (TorchTrainer/BackendExecutor/WorkerGroup/
+session/Checkpoint/FailureConfig). TPU-first deltas: the flagship trainer
+is JaxTrainer; "process group setup" is a Mesh + collective group (no TCP
+rendezvous — in-program collectives ride ICI); checkpoints are orbax-style
+sharded pytrees in a directory.
+"""
+
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
+
+# Reference-name alias: users arriving from the reference find the same
+# entry point name wired to the jax path.
+DataParallelTrainer = JaxTrainer
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingFailedError",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

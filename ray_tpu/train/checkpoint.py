@@ -1,0 +1,99 @@
+"""Checkpoints: directory + pytree persistence (reference role:
+ray/train/_checkpoint.py + StorageContext).
+
+A Checkpoint is a directory. Pytrees save via orbax when available
+(async-capable sharded arrays — the TPU-native path), falling back to a
+numpy .npz flat-tree encoding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # ------------------------------------------------------------- creation
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return Checkpoint(d)
+
+    @staticmethod
+    def from_pytree(tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        d = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(d, exist_ok=True)
+        save_pytree(tree, os.path.join(d, "pytree"))
+        return Checkpoint(d)
+
+    # ------------------------------------------------------------ accessors
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_pytree(self) -> Any:
+        return load_pytree(os.path.join(self.path, "pytree"))
+
+    def copy_to(self, dest: str) -> "Checkpoint":
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return Checkpoint(dest)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    try:
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, tree)
+        return
+    except Exception:  # noqa: BLE001 — orbax optional/strict; use fallback
+        pass
+    import jax
+    import numpy as np
+
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    np.savez(os.path.join(path, "leaves.npz"),
+             **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
+    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load_pytree(path: str) -> Any:
+    flat_file = os.path.join(path, "leaves.npz")
+    if os.path.exists(flat_file):
+        import jax
+        import numpy as np
+
+        data = np.load(flat_file)
+        leaves = [data[str(i)] for i in range(len(data.files))]
+        with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        return jax.tree.unflatten(treedef, leaves)
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path))

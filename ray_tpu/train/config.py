@@ -1,0 +1,38 @@
+"""Train/AIR config dataclasses (reference role: ray/air/config.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False  # reference: use_gpu
+    resources_per_worker: Optional[Dict[str, float]] = None
+
+    @property
+    def total_workers(self) -> int:
+        return max(int(self.num_workers), 1)
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # restarts of the whole worker group
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)

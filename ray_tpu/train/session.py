@@ -1,0 +1,68 @@
+"""Per-worker training session (reference role: ray/train/_internal/session).
+
+Thread-local context carrying rank/world_size/dataset shard; ``report()``
+streams metrics (+ optional checkpoint) back to the trainer through a
+result queue.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int, result_queue,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 trial_name: str = ""):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = world_rank
+        self.trial_name = trial_name
+        self._result_queue = result_queue
+        self._dataset_shards = dataset_shards or {}
+        self._latest_checkpoint = latest_checkpoint
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_trial_name(self) -> str:
+        return self.trial_name
+
+
+def _set_context(ctx: Optional[TrainContext]):
+    _local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "no training session active (call inside train_loop_per_worker)")
+    return ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    ctx = get_context()
+    ctx._result_queue.put(
+        ("report", ctx.world_rank, dict(metrics), checkpoint))
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context()._latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context()._dataset_shards.get(name)

@@ -1,0 +1,194 @@
+"""JaxTrainer: worker-group training with failure recovery (reference role:
+ray/train TorchTrainer + BackendExecutor + WorkerGroup).
+
+N worker actors run ``train_loop_per_worker``; each gets a session
+(rank/world size/dataset shard), joins a collective group for out-of-program
+sync (in-program collectives ride the Mesh), streams ``report()`` metrics,
+and the trainer restarts the whole group from the latest checkpoint up to
+``FailureConfig.max_failures`` times — the reference's group-restart
+semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import collective
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.session import TrainContext, _set_context
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[BaseException] = None
+    path: Optional[str] = None
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[..., None],
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        ray_tpu.init(ignore_reinit_error=True)
+        failures_allowed = self._run_config.failure_config.max_failures
+        latest_ckpt: Optional[Checkpoint] = None
+        history: List[Dict[str, Any]] = []
+        attempt = 0
+        while True:
+            try:
+                metrics, ckpt, hist = self._run_attempt(latest_ckpt)
+                history.extend(hist)
+                result = Result(metrics=metrics, checkpoint=ckpt,
+                                metrics_history=history,
+                                path=self._storage_dir())
+                return result
+            except Exception as exc:  # noqa: BLE001 — group failure boundary
+                attempt += 1
+                # Carry forward any checkpoint reported before the failure.
+                latest_ckpt = getattr(exc, "_latest_checkpoint",
+                                      latest_ckpt)
+                if attempt > failures_allowed:
+                    raise TrainingFailedError(
+                        f"training failed after {attempt - 1} restart(s): "
+                        f"{exc!r}") from exc
+
+    def _storage_dir(self) -> Optional[str]:
+        rc = self._run_config
+        if rc.storage_path is None:
+            return None
+        d = os.path.join(rc.storage_path, rc.name or "train_run")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -------------------------------------------------------------- attempt
+    def _run_attempt(self, restore_from: Optional[Checkpoint]):
+        n = self._scaling.total_workers
+        results: "queue.Queue" = queue.Queue()
+        group_name = f"train-{id(self)}-{time.monotonic_ns()}"
+
+        # Shard datasets per worker (Dataset.split) once per attempt.
+        shards_per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self._datasets.items():
+            if hasattr(ds, "split"):
+                for rank, shard in enumerate(ds.split(n)):
+                    shards_per_worker[rank][name] = shard
+            else:
+                for rank in range(n):
+                    shards_per_worker[rank][name] = ds
+
+        loop = self._loop
+        loop_config = self._loop_config
+        trial_name = self._run_config.name or "train"
+
+        @ray_tpu.remote
+        class TrainWorker:
+            def run(self, rank):
+                collective.init_collective_group(
+                    n, rank, group_name=group_name)
+                ctx = TrainContext(
+                    world_rank=rank, world_size=n, result_queue=results,
+                    dataset_shards=shards_per_worker[rank],
+                    latest_checkpoint=restore_from, trial_name=trial_name)
+                _set_context(ctx)
+                try:
+                    if loop_config:
+                        loop(loop_config)
+                    else:
+                        loop()
+                finally:
+                    _set_context(None)
+                return rank
+
+        workers = [TrainWorker.remote() for _ in range(n)]
+        run_refs = [w.run.remote(i) for i, w in enumerate(workers)]
+
+        # Drain reports while the group runs.
+        history: List[Dict[str, Any]] = []
+        latest_metrics: Dict[str, Any] = {}
+        latest_ckpt = restore_from
+        pending = list(run_refs)
+        try:
+            while pending:
+                try:
+                    kind, rank, metrics, ckpt = results.get(timeout=0.05)
+                    if rank == 0:
+                        history.append(metrics)
+                        latest_metrics = metrics
+                        if ckpt is not None:
+                            latest_ckpt = self._persist(ckpt)
+                    continue
+                except queue.Empty:
+                    pass
+                done, pending = ray_tpu.wait(
+                    pending, num_returns=len(pending), timeout=0.0)
+                if done:
+                    ray_tpu.get(done)  # surface worker errors
+        except Exception as exc:
+            exc._latest_checkpoint = latest_ckpt
+            raise
+        finally:
+            # Drain any reports that raced with completion.
+            while True:
+                try:
+                    kind, rank, metrics, ckpt = results.get_nowait()
+                    if rank == 0:
+                        history.append(metrics)
+                        latest_metrics = metrics
+                        if ckpt is not None:
+                            latest_ckpt = self._persist(ckpt)
+                except queue.Empty:
+                    break
+            collective.destroy_collective_group(group_name)
+        return latest_metrics, latest_ckpt, history
+
+    def _persist(self, ckpt: Checkpoint) -> Checkpoint:
+        storage = self._storage_dir()
+        if storage is None:
+            return ckpt
+        dest = os.path.join(
+            storage, f"checkpoint_{time.monotonic_ns()}")
+        out = ckpt.copy_to(dest)
+        keep = self._run_config.checkpoint_config.num_to_keep
+        if keep:
+            ckpts = sorted(
+                d for d in os.listdir(storage)
+                if d.startswith("checkpoint_"))
+            for stale in ckpts[:-keep]:
+                import shutil
+
+                shutil.rmtree(os.path.join(storage, stale),
+                              ignore_errors=True)
+        return out
+
+    @staticmethod
+    def restore(path: str, **kwargs) -> "JaxTrainer":
+        raise NotImplementedError(
+            "restore(): construct a new trainer and pass the checkpoint "
+            "via RunConfig.storage_path; trial-level restore lands with "
+            "tune.Tuner.restore")

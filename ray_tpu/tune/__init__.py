@@ -1,0 +1,48 @@
+"""ray_tpu.tune: hyperparameter search (reference role: python/ray/tune).
+
+Tuner → TuneController trial state machine over actor-backed trials;
+search spaces (grid/choice/uniform/loguniform/randint), BasicVariant
+search, ASHA / Median-stopping / HyperBand-lite schedulers, PBT mutation.
+"""
+
+from ray_tpu.tune.search_space import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    randn,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TrialResult,
+    TuneConfig,
+    Tuner,
+    report,
+)
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "TrialResult",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "qrandint",
+    "randint",
+    "randn",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,206 @@
+"""Tuner + trial controller (reference role: ray/tune/tuner.py +
+tune/execution/tune_controller.py trial state machine).
+
+Trials run as actor tasks; the controller drains a shared report queue,
+feeds the scheduler, and delivers stop decisions back to trials through a
+shared stop-set the session checks on every report.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search_space import generate_variants
+
+_local = threading.local()
+
+
+class _TrialStopped(Exception):
+    pass
+
+
+class _TuneSession:
+    def __init__(self, trial_id: str, report_queue, stop_set, stop_lock):
+        self.trial_id = trial_id
+        self.report_queue = report_queue
+        self.stop_set = stop_set
+        self.stop_lock = stop_lock
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Inside a trainable: stream metrics; raises to unwind when the
+    scheduler has stopped this trial.
+
+    Blocks until the controller has processed this report (ack event), so
+    scheduler decisions are synchronous with trial progress — the
+    reference's result-processing semantics, and what makes ASHA cuts
+    deterministic rather than racing free-running trial threads.
+    """
+    sess = getattr(_local, "tune_session", None)
+    if sess is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    ack = threading.Event()
+    sess.report_queue.put((sess.trial_id, dict(metrics), checkpoint, ack))
+    ack.wait(timeout=30)
+    with sess.stop_lock:
+        if sess.trial_id in sess.stop_set:
+            raise _TrialStopped()
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "score"
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+
+    @property
+    def last_result(self):
+        return self.metrics
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {"trial_id": r.trial_id, **r.config, **r.metrics}
+            for r in self._results
+        ])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[Dict[str, Any]], Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        ray_tpu.init(ignore_reinit_error=True)
+        tc = self._tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(
+            self._param_space, tc.num_samples, seed=tc.seed)
+        trials = {
+            f"trial_{i:05d}": TrialResult(f"trial_{i:05d}", cfg)
+            for i, cfg in enumerate(variants)
+        }
+        if hasattr(scheduler, "register"):
+            for tid, tr in trials.items():
+                scheduler.register(tid, tr.config)
+
+        report_queue: "queue.Queue" = queue.Queue()
+        stop_set: set = set()
+        stop_lock = threading.Lock()
+        trainable = self._trainable
+
+        @ray_tpu.remote
+        def run_trial(trial_id, config):
+            _local.tune_session = _TuneSession(
+                trial_id, report_queue, stop_set, stop_lock)
+            try:
+                out = trainable(config)
+                if isinstance(out, dict):
+                    done_ack = threading.Event()
+                    report_queue.put((trial_id, out, None, done_ack))
+                return "COMPLETED"
+            except _TrialStopped:
+                return "EARLY_STOPPED"
+            finally:
+                _local.tune_session = None
+
+        pending = list(trials.items())
+        running: Dict[Any, str] = {}
+        final_status: Dict[str, str] = {}
+        while pending or running:
+            while pending and len(running) < tc.max_concurrent_trials:
+                tid, trial = pending.pop(0)
+                ref = run_trial.remote(tid, trial.config)
+                running[ref] = tid
+            # Drain reports -> scheduler decisions.
+            try:
+                while True:
+                    tid, metrics, ckpt, ack = report_queue.get_nowait()
+                    trials[tid].metrics = metrics
+                    trials[tid].metrics_history.append(metrics)
+                    if ckpt is not None:
+                        trials[tid].checkpoint = ckpt
+                    if scheduler.on_result(tid, metrics) == STOP:
+                        with stop_lock:
+                            stop_set.add(tid)
+                    if hasattr(scheduler, "maybe_exploit"):
+                        new_cfg = scheduler.maybe_exploit(tid)
+                        if new_cfg is not None:
+                            trials[tid].config.update(new_cfg)
+                    ack.set()
+            except queue.Empty:
+                pass
+            done, _ = ray_tpu.wait(
+                list(running), num_returns=1, timeout=0.05)
+            for ref in done:
+                tid = running.pop(ref)
+                try:
+                    final_status[tid] = ray_tpu.get(ref)
+                except Exception as exc:  # noqa: BLE001 — trial failure
+                    trials[tid].error = repr(exc)
+                    final_status[tid] = "ERRORED"
+        # Final queue drain.
+        try:
+            while True:
+                tid, metrics, ckpt, ack = report_queue.get_nowait()
+                trials[tid].metrics = metrics
+                trials[tid].metrics_history.append(metrics)
+                if ckpt is not None:
+                    trials[tid].checkpoint = ckpt
+                ack.set()
+        except queue.Empty:
+            pass
+        return ResultGrid(list(trials.values()), tc.metric, tc.mode)

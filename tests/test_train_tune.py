@@ -1,0 +1,208 @@
+"""Train/Tune tests (reference model: ray/train + ray/tune test suites —
+worker-group semantics, checkpoint/restore, failure recovery, searchers,
+schedulers)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+from ray_tpu import train
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+def test_trainer_ranks_and_report():
+    seen = []
+    lock = threading.Lock()
+
+    def loop():
+        ctx = train.get_context()
+        with lock:
+            seen.append((ctx.get_world_rank(), ctx.get_world_size()))
+        train.report({"rank": ctx.get_world_rank(), "loss": 1.0})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=4)).fit()
+    assert sorted(r for r, _ in seen) == [0, 1, 2, 3]
+    assert all(w == 4 for _, w in seen)
+    assert result.metrics["loss"] == 1.0
+
+
+def test_trainer_collective_between_workers():
+    from ray_tpu import collective as col
+
+    def loop():
+        ctx = train.get_context()
+        col.init_collective_group(4, ctx.get_world_rank(),
+                                  group_name="t_all")
+        out = col.allreduce(np.asarray([float(ctx.get_world_rank())]),
+                            group_name="t_all")
+        train.report({"sum": float(out[0])})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=4)).fit()
+    col.destroy_collective_group("t_all")
+    assert result.metrics["sum"] == 6.0
+
+
+def test_trainer_checkpoint_and_storage(tmp_path):
+    def loop():
+        ctx = train.get_context()
+        for step in range(3):
+            ckpt = Checkpoint.from_dict({"step": step})
+            if ctx.get_world_rank() == 0:
+                train.report({"step": step}, checkpoint=ckpt)
+            else:
+                train.report({"step": step})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ckpt_run", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["step"] == 2
+    assert "ckpt_run" in result.checkpoint.path
+
+
+def test_trainer_failure_restart_from_checkpoint():
+    attempts = []
+
+    def loop(config):
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        attempts.append(start)
+        for step in range(start, 4):
+            train.report({"step": step},
+                         checkpoint=Checkpoint.from_dict({"step": step}))
+            if step == 1 and len(attempts) == 1:
+                raise RuntimeError("injected worker failure")
+
+    result = JaxTrainer(
+        loop, train_loop_config={"x": 1},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert result.metrics["step"] == 3
+    # Second attempt resumed past step 0.
+    assert attempts[1] >= 1
+
+
+def test_trainer_failure_exhausted():
+    def loop():
+        raise ValueError("always fails")
+
+    with pytest.raises(TrainingFailedError):
+        JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                   run_config=RunConfig(
+                       failure_config=FailureConfig(max_failures=1))).fit()
+
+
+def test_trainer_dataset_sharding():
+    import ray_tpu.data as rd
+
+    rows_seen = []
+    lock = threading.Lock()
+
+    def loop():
+        shard = train.get_dataset_shard("train")
+        n = shard.count()
+        with lock:
+            rows_seen.append(n)
+        train.report({"rows": n})
+
+    JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=4),
+        datasets={"train": rd.range(100)}).fit()
+    assert sum(rows_seen) == 100
+
+
+def test_tune_grid_and_best():
+    def trainable(config):
+        return {"score": config["a"] * 10 + config["b"]}
+
+    grid = Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2, 3]),
+                     "b": tune.uniform(0, 0.5)},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.config["a"] == 3
+
+
+def test_tune_asha_stops_bad_trials_early():
+    iters_run = {}
+    lock = threading.Lock()
+
+    def trainable(config):
+        for i in range(32):
+            with lock:
+                iters_run[config["slope"]] = i + 1
+            tune.report({"score": config["slope"] * (i + 1)})
+
+    Tuner(
+        trainable,
+        param_space={"slope": tune.grid_search(
+            [50.0, 20.0, 10.0, 0.05, 0.02, 0.01])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+            scheduler=ASHAScheduler(metric="score", max_t=32,
+                                    grace_period=2, reduction_factor=2)),
+    ).fit()
+    # The weakest configs must have been cut before exhausting max_t.
+    assert min(iters_run.values()) < 32
+    assert iters_run[50.0] == 32
+
+
+def test_tune_asha_prefers_good():
+    def trainable(config):
+        for i in range(16):
+            tune.report({"score": config["slope"] * (i + 1)})
+
+    grid = Tuner(
+        trainable,
+        param_space={"slope": tune.grid_search(
+            [0.1, 0.2, 0.5, 1.0, 2.0, 5.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+            scheduler=ASHAScheduler(metric="score", max_t=16,
+                                    grace_period=2, reduction_factor=2)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["slope"] == 5.0
+
+
+def test_tune_trial_error_isolated():
+    def trainable(config):
+        if config["x"] == 1:
+            raise RuntimeError("boom")
+        return {"score": config["x"]}
+
+    grid = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    ).fit()
+    errs = [r for r in grid if r.error]
+    assert len(errs) == 1
+    assert grid.get_best_result().config["x"] == 2
