@@ -1,0 +1,42 @@
+"""ray_tpu.serve: model serving (reference role: python/ray/serve).
+
+Controller reconciles deployments to target replica counts; replicas are
+actors; a Router picks replicas per request with power-of-two-choices on
+queue length; DeploymentHandles compose deployments (async futures);
+@serve.batch dynamically batches — the TPU-relevant feature, since batching
+is what keeps the MXU fed at serving time. HTTP ingress is a thin stdlib
+http.server proxy (the reference uses uvicorn; no new deps here).
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    batch,
+    delete,
+    deployment,
+    get_deployment_handle,
+    ingress,
+    multiplexed,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "ingress",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,244 @@
+"""Public serve API (reference role: serve/api.py — @serve.deployment,
+.bind() applications, serve.run, @serve.batch, @serve.multiplexed)."""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import (
+    AutoscalingConfig,
+    get_or_create_controller,
+    shutdown_controller,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class Application:
+    """A bound deployment graph root (result of Deployment.bind)."""
+
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
+                 autoscaling_config: Optional[dict] = None,
+                 **_opts):
+        self._target = cls_or_fn
+        self.name = name
+        self.num_replicas = num_replicas
+        self.autoscaling_config = autoscaling_config
+
+    def options(self, **opts) -> "Deployment":
+        merged = dict(
+            name=self.name, num_replicas=self.num_replicas,
+            autoscaling_config=self.autoscaling_config)
+        merged.update(opts)
+        return Deployment(self._target, **merged)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(_cls=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               autoscaling_config: Optional[dict] = None, **opts):
+    """@serve.deployment decorator for classes or functions."""
+
+    def wrap(cls):
+        target = cls
+        if not isinstance(cls, type):
+            # Function deployment: wrap into a callable class.
+            fn = cls
+
+            class _FnDeployment:
+                def __call__(self, *a, **k):
+                    return fn(*a, **k)
+
+            _FnDeployment.__name__ = getattr(fn, "__name__", "fn")
+            target = _FnDeployment
+        return Deployment(
+            target, name or getattr(cls, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            autoscaling_config=autoscaling_config, **opts)
+
+    return wrap(_cls) if _cls is not None else wrap
+
+
+def _deploy_app(app: Application) -> DeploymentHandle:
+    """Deploy an application graph: bound handle args resolve depth-first
+    (deployment composition — reference handle-passing semantics)."""
+    controller = get_or_create_controller()
+
+    def resolve(value):
+        if isinstance(value, Application):
+            return _deploy_app(value)
+        return value
+
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    d = app.deployment
+    auto = None
+    if d.autoscaling_config:
+        auto = AutoscalingConfig(**d.autoscaling_config)
+    controller.deploy(d.name, d._target, args, kwargs,
+                      num_replicas=d.num_replicas, autoscaling=auto)
+    return DeploymentHandle(d.name, controller)
+
+
+def run(app: Application, *, name: str = "default", route_prefix: str = "/",
+        blocking: bool = False) -> DeploymentHandle:
+    ray_tpu.init(ignore_reinit_error=True)
+    handle = _deploy_app(app)
+    return handle
+
+
+def start(detached: bool = False, **_opts):
+    ray_tpu.init(ignore_reinit_error=True)
+    get_or_create_controller()
+
+
+def status() -> Dict[str, Any]:
+    return get_or_create_controller().status()
+
+
+def delete(name: str):
+    get_or_create_controller().delete(name)
+
+
+def shutdown():
+    shutdown_controller()
+
+
+def get_deployment_handle(name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(name, get_or_create_controller())
+
+
+def ingress(fastapi_app=None):
+    """FastAPI ingress shim: framework-HTTP is served by serve.http's thin
+    proxy; this decorator marks the class for route extraction."""
+
+    def wrap(cls):
+        cls.__serve_ingress__ = fastapi_app
+        return cls
+
+    return wrap
+
+
+# ----------------------------------------------------------------- batching
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Dynamic request batching (reference role: serve/batching.py).
+
+    Decorate a method taking a LIST of inputs and returning a LIST of
+    outputs; concurrent callers are coalesced up to max_batch_size or until
+    the wait timeout — the mechanism that keeps TPU serving on large
+    batches. Thread-safe (replica actors may run with max_concurrency>1).
+    """
+
+    def wrap(fn):
+        lock = threading.Lock()
+        pending: List = []  # (arg, event, slot)
+
+        def flush(batch_items):
+            args = [it[0] for it in batch_items]
+            try:
+                results = fn(batch_items[0][3], args) if batch_items[0][3] \
+                    is not None else fn(args)
+                if len(results) != len(args):
+                    raise ValueError(
+                        f"batched fn returned {len(results)} results for "
+                        f"{len(args)} inputs")
+                for it, res in zip(batch_items, results):
+                    it[2]["value"] = res
+                    it[1].set()
+            except BaseException as exc:  # noqa: BLE001
+                for it in batch_items:
+                    it[2]["error"] = exc
+                    it[1].set()
+
+        @functools.wraps(fn)
+        def wrapper(*call_args):
+            # Support bound methods: (self, item) or plain (item,).
+            if len(call_args) == 2:
+                self_obj, arg = call_args
+            else:
+                self_obj, arg = None, call_args[0]
+            event = threading.Event()
+            slot: Dict[str, Any] = {}
+            with lock:
+                pending.append((arg, event, slot, self_obj))
+                is_leader = len(pending) == 1
+            if is_leader:
+                deadline = time.monotonic() + batch_wait_timeout_s
+                while time.monotonic() < deadline:
+                    with lock:
+                        if len(pending) >= max_batch_size:
+                            break
+                    time.sleep(batch_wait_timeout_s / 10)
+                # Drain everything queued (in max_batch_size chunks) before
+                # abdicating: callers that joined after this leader's first
+                # batch filled would otherwise wait with no one flushing.
+                while True:
+                    with lock:
+                        batch_items = pending[:max_batch_size]
+                        del pending[:len(batch_items)]
+                    if not batch_items:
+                        break
+                    flush(batch_items)
+            event.wait(timeout=30)
+            if "error" in slot:
+                raise slot["error"]
+            return slot["value"]
+
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return wrap(_fn) if _fn is not None else wrap
+
+
+# -------------------------------------------------------------- multiplexing
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Per-replica LRU model cache (reference role: serve/multiplex.py).
+
+    Decorate an async or sync model-loader method keyed by model_id; the
+    wrapper evicts least-recently-used models beyond the cap.
+    """
+
+    def wrap(fn):
+        cache: "OrderedDict[str, Any]" = OrderedDict()
+        lock = threading.Lock()
+
+        @functools.wraps(fn)
+        def wrapper(self_or_id, model_id=None):
+            if model_id is None:
+                self_obj, mid = None, self_or_id
+            else:
+                self_obj, mid = self_or_id, model_id
+            with lock:
+                if mid in cache:
+                    cache.move_to_end(mid)
+                    return cache[mid]
+            model = fn(mid) if self_obj is None else fn(self_obj, mid)
+            if asyncio.iscoroutine(model):
+                model = asyncio.get_event_loop().run_until_complete(model)
+            with lock:
+                cache[mid] = model
+                cache.move_to_end(mid)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+            return model
+
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return wrap(_fn) if _fn is not None else wrap

@@ -1,0 +1,221 @@
+"""ServeController: deployment reconciliation + autoscaling (reference
+role: serve/_private/controller.py + deployment_state.py +
+autoscaling_policy.py).
+
+Target state (deployments + replica counts) vs actual state (live replica
+actors) reconciled by a background loop; autoscaling adjusts target counts
+from ongoing-request telemetry within [min_replicas, max_replicas].
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.router import ReplicaSet
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    cls: type
+    init_args: tuple
+    init_kwargs: dict
+    num_replicas: int
+    autoscaling: Optional[AutoscalingConfig]
+    replicas: List[Any] = field(default_factory=list)
+    replica_set: ReplicaSet = field(default_factory=ReplicaSet)
+    status: str = "UPDATING"
+    request_count: int = 0
+    last_scale_change: float = 0.0
+
+
+class ServeController:
+    """In-process controller singleton (the reference runs this as a
+    detached actor; here the runtime is process-local, so it is a
+    supervisor object with a reconciler thread)."""
+
+    def __init__(self):
+        self._deployments: Dict[str, DeploymentInfo] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True,
+            name="serve-controller")
+        self._thread.start()
+
+    # -------------------------------------------------------------- deploy
+    def deploy(self, name: str, cls: type, init_args, init_kwargs,
+               num_replicas: int,
+               autoscaling: Optional[AutoscalingConfig]) -> None:
+        with self._lock:
+            old = self._deployments.get(name)
+            info = DeploymentInfo(
+                name=name, cls=cls, init_args=init_args,
+                init_kwargs=init_kwargs, num_replicas=num_replicas,
+                autoscaling=autoscaling)
+            if old is not None:
+                info.replicas = old.replicas
+                info.replica_set = old.replica_set
+            self._deployments[name] = info
+        self._reconcile_once()
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            info = self._deployments.pop(name, None)
+        if info:
+            for r in info.replicas:
+                ray_tpu.kill(r)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            names = list(self._deployments)
+        for n in names:
+            self.delete(n)
+
+    # ----------------------------------------------------------- reconcile
+    def _reconcile_loop(self):
+        while not self._stop.wait(0.25):
+            try:
+                self._autoscale()
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — keep the controller alive
+                pass
+
+    def _reconcile_once(self):
+        with self._lock:
+            infos = list(self._deployments.values())
+        for info in infos:
+            target = info.num_replicas
+            # Replace dead replicas first (failure recovery).
+            live = [r for r in info.replicas if not r._runtime.dead]
+            while len(live) < target:
+                live.append(self._start_replica(info))
+            while len(live) > target:
+                ray_tpu.kill(live.pop())
+            info.replicas = live
+            info.replica_set.update(live)
+            info.status = "HEALTHY"
+
+    def _start_replica(self, info: DeploymentInfo):
+        user_cls = info.cls
+        init_args, init_kwargs = info.init_args, info.init_kwargs
+
+        @ray_tpu.remote
+        class Replica:
+            def __init__(self):
+                self._user = user_cls(*init_args, **init_kwargs)
+
+            def handle_request(self, method, args, kwargs):
+                # User args travel packed in a tuple, so chained
+                # DeploymentResponse ObjectRefs are nested one level deep —
+                # resolve them here (the composition contract).
+                args = tuple(
+                    ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef)
+                    else a for a in args)
+                kwargs = {
+                    k: (ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef)
+                        else v)
+                    for k, v in kwargs.items()
+                }
+                fn = (self._user if method == "__call__"
+                      else getattr(self._user, method))
+                if not callable(fn):
+                    raise TypeError(
+                        f"deployment {user_cls.__name__}.{method} is not "
+                        f"callable")
+                return fn(*args, **kwargs)
+
+            def health_check(self):
+                return True
+
+        # Replicas serve concurrently (reference default: 100 ongoing
+        # requests per replica) — required for @serve.batch to coalesce.
+        return Replica.options(max_concurrency=100).remote()
+
+    # ---------------------------------------------------------- autoscale
+    def _autoscale(self):
+        now = time.monotonic()
+        with self._lock:
+            infos = list(self._deployments.values())
+        for info in infos:
+            cfg = info.autoscaling
+            if cfg is None:
+                continue
+            qlens = info.replica_set.queue_lengths()
+            if not qlens:
+                continue
+            ongoing = sum(qlens) / len(qlens)
+            if (ongoing > cfg.target_ongoing_requests
+                    and info.num_replicas < cfg.max_replicas
+                    and now - info.last_scale_change > cfg.upscale_delay_s):
+                info.num_replicas += 1
+                info.last_scale_change = now
+            elif (ongoing < cfg.target_ongoing_requests / 2
+                  and info.num_replicas > cfg.min_replicas
+                  and now - info.last_scale_change > cfg.downscale_delay_s):
+                info.num_replicas -= 1
+                info.last_scale_change = now
+
+    # ------------------------------------------------------------- queries
+    def _replica_set(self, name: str) -> ReplicaSet:
+        with self._lock:
+            info = self._deployments.get(name)
+        if info is None:
+            raise KeyError(f"no deployment named {name!r}")
+        # Lazily ensure replicas exist before first routing.
+        if info.replica_set.size() == 0:
+            self._reconcile_once()
+        return info.replica_set
+
+    def _record_request(self, name: str):
+        with self._lock:
+            info = self._deployments.get(name)
+            if info:
+                info.request_count += 1
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "status": info.status,
+                    "replicas": len(info.replicas),
+                    "target_replicas": info.num_replicas,
+                    "requests": info.request_count,
+                    "queue_lengths": info.replica_set.queue_lengths(),
+                }
+                for name, info in self._deployments.items()
+            }
+
+
+_controller: Optional[ServeController] = None
+_controller_lock = threading.Lock()
+
+
+def get_or_create_controller() -> ServeController:
+    global _controller
+    with _controller_lock:
+        if _controller is None or _controller._stop.is_set():
+            _controller = ServeController()
+        return _controller
+
+
+def shutdown_controller():
+    global _controller
+    with _controller_lock:
+        if _controller is not None:
+            _controller.shutdown()
+            _controller = None

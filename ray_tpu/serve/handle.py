@@ -1,0 +1,89 @@
+"""DeploymentHandle / DeploymentResponse (reference role:
+serve/handle.py — composable async handles whose responses chain)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future for one routed request; passing it as an argument to another
+    handle call chains without blocking (resolved at dispatch)."""
+
+    def __init__(self, ref, replica_set, replica_idx):
+        self._ref = ref
+        self._rs = replica_set
+        self._idx = replica_idx
+        self._released = False
+        self._lock = threading.Lock()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._release()
+
+    def _release(self):
+        with self._lock:
+            if not self._released:
+                self._released = True
+                self._rs.release(self._idx)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __del__(self):
+        # Chained responses never see .result(); free the router slot so
+        # queue-length telemetry (autoscaling) doesn't leak in-flight
+        # counts forever.
+        try:
+            self._release()
+        except Exception:  # noqa: BLE001 — interpreter-teardown safety
+            pass
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller,
+                 method_name: str = "__call__"):
+        self._name = deployment_name
+        self._controller = controller
+        self._method = method_name
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, self._controller, method_name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        rs = self._controller._replica_set(self._name)
+        idx, replica = rs.choose()
+        # Chain: unwrap DeploymentResponses into ObjectRefs so downstream
+        # deployments receive resolved values without blocking here.
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args)
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                else v)
+            for k, v in kwargs.items()
+        }
+        method = getattr(replica, "handle_request")
+        ref = method.remote(self._method, args, kwargs)
+        resp = DeploymentResponse(ref, rs, idx)
+        self._controller._record_request(self._name)
+        return resp
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle.options(self._method).remote(*args, **kwargs)

@@ -1,0 +1,84 @@
+"""HTTP ingress proxy (reference role: serve/_private/proxy.py — there a
+uvicorn/gRPC server per node; here a stdlib ThreadingHTTPServer, zero new
+dependencies).
+
+POST/GET /<deployment> routes the JSON body to the deployment's handle via
+the same pow-2 router as handle calls.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_tpu.serve.controller import get_or_create_controller
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+    def _route(self):
+        name = self.path.strip("/").split("/")[0]
+        if not name:
+            self.send_response(404)
+            self.end_headers()
+            self.wfile.write(b'{"error": "no deployment in path"}')
+            return
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            arg = json.loads(body) if body else None
+            handle = DeploymentHandle(name, get_or_create_controller())
+            result = (handle.remote(arg) if arg is not None
+                      else handle.remote()).result(timeout=30)
+            payload = json.dumps({"result": result}).encode()
+            self.send_response(200)
+        except KeyError:
+            payload = json.dumps({"error": f"no deployment {name!r}"}
+                                 ).encode()
+            self.send_response(404)
+        except Exception as exc:  # noqa: BLE001 — request error boundary
+            payload = json.dumps({"error": repr(exc)}).encode()
+            self.send_response(500)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    do_GET = _route
+    do_POST = _route
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serve-http-proxy")
+        self._thread.start()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_proxy: Optional[HTTPProxy] = None
+
+
+def start_proxy(host: str = "127.0.0.1", port: int = 8000) -> HTTPProxy:
+    global _proxy
+    if _proxy is None:
+        _proxy = HTTPProxy(host, port)
+    return _proxy
+
+
+def stop_proxy():
+    global _proxy
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None

@@ -1,0 +1,54 @@
+"""Replica scheduling (reference role: serve/_private/replica_scheduler/
+pow_2_scheduler.py — power-of-two-choices on replica queue length)."""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class ReplicaSet:
+    """Tracks live replica handles + their in-flight request counts."""
+
+    def __init__(self):
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._rng = random.Random(0)
+
+    def update(self, replicas: List[Any]):
+        with self._lock:
+            self._replicas = list(replicas)
+            self._inflight = {
+                i: self._inflight.get(i, 0)
+                for i in range(len(replicas))
+            }
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def choose(self) -> (int, Any):
+        """Power of two choices: sample two replicas, pick the one with the
+        shorter queue. Falls back to the single replica when size==1."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError("no replicas available")
+            if n == 1:
+                idx = 0
+            else:
+                a, b = self._rng.sample(range(n), 2)
+                idx = a if self._inflight[a] <= self._inflight[b] else b
+            self._inflight[idx] += 1
+            return idx, self._replicas[idx]
+
+    def release(self, idx: int):
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    def queue_lengths(self) -> List[int]:
+        with self._lock:
+            return [self._inflight[i] for i in range(len(self._replicas))]
