@@ -1,0 +1,22 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Auto-compiles ``libray_tpu_native.so`` with g++ on first import (cached in
+``~/.cache/ray_tpu``, keyed by source hash). Gate: ``native_available()``
+is False when no toolchain exists — every consumer has a pure-Python
+fallback, so the framework degrades rather than breaks.
+"""
+
+from ray_tpu._native.build import load_native, native_available
+from ray_tpu._native.store import (
+    NativeObjectStore,
+    NativeMutableChannel,
+    NativeTaskQueue,
+)
+
+__all__ = [
+    "NativeMutableChannel",
+    "NativeObjectStore",
+    "NativeTaskQueue",
+    "load_native",
+    "native_available",
+]

@@ -1,0 +1,186 @@
+// Dependency-tracking ready queue — the scheduler hot loop in native code.
+//
+// Reference role: the raylet's LocalTaskManager/ClusterTaskManager dispatch
+// queues (src/ray/raylet/scheduling/*.cc [unverified]). Re-designed for the
+// wave model this framework uses: a task graph with in-degrees, a ready
+// ring, and O(1) completion propagation over a CSR edge list — the host-side
+// companion of the on-device lax.while_loop frontier executor (host side
+// feeds waves; device side runs them).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <pthread.h>
+
+namespace {
+
+struct TaskQueue {
+  uint32_t max_tasks;
+  uint32_t max_edges;
+  int32_t* indeg;        // per task
+  uint8_t* done;
+  // CSR edges: head[t]..head[t+1] gives consumer list.
+  uint32_t* edge_src;    // staging before seal
+  uint32_t* edge_dst;
+  uint32_t num_edges;
+  uint32_t* csr_head;    // size max_tasks+1
+  uint32_t* csr_dst;
+  int sealed;
+  // Ready ring.
+  uint32_t* ring;
+  uint32_t ring_cap;
+  uint32_t rhead, rtail;
+  uint32_t num_tasks;
+  uint32_t num_done;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+};
+
+void push_ready(TaskQueue* q, uint32_t t) {
+  q->ring[q->rtail % q->ring_cap] = t;
+  q->rtail++;
+}
+
+timespec deadline_from_ms(int64_t timeout_ms) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) { ts.tv_sec++; ts.tv_nsec -= 1000000000L; }
+  return ts;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rtn_tq_create(uint32_t max_tasks, uint32_t max_edges) {
+  TaskQueue* q = new TaskQueue();
+  memset(q, 0, sizeof(TaskQueue));
+  q->max_tasks = max_tasks;
+  q->max_edges = max_edges;
+  q->indeg = new int32_t[max_tasks]();
+  q->done = new uint8_t[max_tasks]();
+  q->edge_src = new uint32_t[max_edges];
+  q->edge_dst = new uint32_t[max_edges];
+  q->csr_head = new uint32_t[max_tasks + 1]();
+  q->csr_dst = new uint32_t[max_edges];
+  q->ring_cap = max_tasks + 1;
+  q->ring = new uint32_t[q->ring_cap];
+  pthread_mutex_init(&q->mu, nullptr);
+  pthread_cond_init(&q->cv, nullptr);
+  return q;
+}
+
+void rtn_tq_destroy(void* handle) {
+  TaskQueue* q = (TaskQueue*)handle;
+  delete[] q->indeg;
+  delete[] q->done;
+  delete[] q->edge_src;
+  delete[] q->edge_dst;
+  delete[] q->csr_head;
+  delete[] q->csr_dst;
+  delete[] q->ring;
+  pthread_mutex_destroy(&q->mu);
+  pthread_cond_destroy(&q->cv);
+  delete q;
+}
+
+int rtn_tq_add_task(void* handle, uint32_t task_id) {
+  TaskQueue* q = (TaskQueue*)handle;
+  if (task_id >= q->max_tasks || q->sealed) return -1;
+  if (task_id + 1 > q->num_tasks) q->num_tasks = task_id + 1;
+  return 0;
+}
+
+int rtn_tq_add_edge(void* handle, uint32_t src, uint32_t dst) {
+  TaskQueue* q = (TaskQueue*)handle;
+  if (q->sealed || q->num_edges >= q->max_edges) return -1;
+  if (src >= q->max_tasks || dst >= q->max_tasks) return -1;
+  q->edge_src[q->num_edges] = src;
+  q->edge_dst[q->num_edges] = dst;
+  q->num_edges++;
+  q->indeg[dst]++;
+  return 0;
+}
+
+int rtn_tq_seal(void* handle) {
+  TaskQueue* q = (TaskQueue*)handle;
+  if (q->sealed) return -1;
+  // Build CSR: counting sort by src.
+  for (uint32_t i = 0; i < q->num_edges; i++) q->csr_head[q->edge_src[i] + 1]++;
+  for (uint32_t t = 0; t < q->num_tasks; t++) q->csr_head[t + 1] += q->csr_head[t];
+  uint32_t* cursor = new uint32_t[q->num_tasks]();
+  for (uint32_t i = 0; i < q->num_edges; i++) {
+    uint32_t s = q->edge_src[i];
+    q->csr_dst[q->csr_head[s] + cursor[s]] = q->edge_dst[i];
+    cursor[s]++;
+  }
+  delete[] cursor;
+  pthread_mutex_lock(&q->mu);
+  q->sealed = 1;
+  for (uint32_t t = 0; t < q->num_tasks; t++)
+    if (q->indeg[t] == 0) push_ready(q, t);
+  pthread_cond_broadcast(&q->cv);
+  pthread_mutex_unlock(&q->mu);
+  return 0;
+}
+
+// Mark tasks complete; newly-ready consumers enter the ring. Batched — the
+// wave executor completes a whole wave per call.
+int rtn_tq_complete(void* handle, const uint32_t* tasks, uint32_t n) {
+  TaskQueue* q = (TaskQueue*)handle;
+  pthread_mutex_lock(&q->mu);
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t t = tasks[i];
+    if (t >= q->num_tasks || q->done[t]) continue;
+    q->done[t] = 1;
+    q->num_done++;
+    for (uint32_t e = q->csr_head[t]; e < q->csr_head[t + 1]; e++) {
+      uint32_t c = q->csr_dst[e];
+      if (--q->indeg[c] == 0) push_ready(q, c);
+    }
+  }
+  pthread_cond_broadcast(&q->cv);
+  pthread_mutex_unlock(&q->mu);
+  return 0;
+}
+
+// Pop up to max ready tasks (the next wave). Blocks up to timeout_ms when
+// none ready and the graph is unfinished; returns count (0 = all done or
+// timeout).
+int rtn_tq_pop_wave(void* handle, uint32_t* out, uint32_t max,
+                    int64_t timeout_ms) {
+  TaskQueue* q = (TaskQueue*)handle;
+  timespec dl = deadline_from_ms(timeout_ms);
+  pthread_mutex_lock(&q->mu);
+  while (q->rhead == q->rtail && q->num_done < q->num_tasks) {
+    if (pthread_cond_timedwait(&q->cv, &q->mu, &dl) == ETIMEDOUT) {
+      pthread_mutex_unlock(&q->mu);
+      return 0;
+    }
+  }
+  uint32_t n = 0;
+  while (q->rhead != q->rtail && n < max) {
+    out[n++] = q->ring[q->rhead % q->ring_cap];
+    q->rhead++;
+  }
+  pthread_mutex_unlock(&q->mu);
+  return (int)n;
+}
+
+uint32_t rtn_tq_num_done(void* handle) {
+  TaskQueue* q = (TaskQueue*)handle;
+  pthread_mutex_lock(&q->mu);
+  uint32_t d = q->num_done;
+  pthread_mutex_unlock(&q->mu);
+  return d;
+}
+
+uint32_t rtn_tq_num_tasks(void* handle) {
+  return ((TaskQueue*)handle)->num_tasks;
+}
+
+}  // extern "C"

@@ -16,9 +16,29 @@ from ray_tpu.channels.channel import (
     IntraProcessChannel,
 )
 
+
+def SharedMemoryChannel(max_size: int = 1 << 20, num_readers: int = 1,
+                        store=None):
+    """Cross-process channel over the native shm store's mutable objects
+    (reference: shared_memory_channel.py over plasma mutable objects)."""
+    from ray_tpu._native import NativeMutableChannel, NativeObjectStore
+
+    if store is None:
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        store = getattr(worker, "_native_channel_store", None)
+        if store is None:
+            store = NativeObjectStore.create()
+            worker._native_channel_store = store
+    return NativeMutableChannel(store, max_size=max_size,
+                                num_readers=num_readers)
+
+
 __all__ = [
     "BufferedChannel",
     "Channel",
     "CompositeChannel",
     "IntraProcessChannel",
+    "SharedMemoryChannel",
 ]
