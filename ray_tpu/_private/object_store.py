@@ -30,7 +30,7 @@ class _Entry:
     __slots__ = (
         "serialized", "error", "ready", "size", "spilled_path",
         "local_refs", "submitted_refs", "pinned_for_lineage", "callbacks",
-        "create_time",
+        "create_time", "lost",
     )
 
     def __init__(self):
@@ -44,6 +44,7 @@ class _Entry:
         self.pinned_for_lineage = False
         self.callbacks: List[Callable[[], None]] = []
         self.create_time = time.monotonic()
+        self.lost = False
 
 
 class ObjectStore:
@@ -67,6 +68,7 @@ class ObjectStore:
             entry.serialized = serialized
             entry.size = serialized.total_bytes()
             entry.ready = True
+            entry.lost = False
             self._memory_used += entry.size
             callbacks, entry.callbacks = entry.callbacks, []
             self._cv.notify_all()
@@ -190,6 +192,44 @@ class ObjectStore:
             if e is None:
                 return (0, 0)
             return (e.local_refs, e.submitted_refs)
+
+    def mark_lost(self, object_id: ObjectID):
+        """Simulated node loss: drop the payload; the entry reverts to
+        pending with a lost flag so owners can trigger lineage
+        reconstruction (ObjectRecoveryManager parity)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.ready:
+                return
+            if e.serialized is not None:
+                self._memory_used -= e.size
+            e.serialized = None
+            e.error = None
+            e.ready = False
+            e.spilled_path = None
+            e.lost = True
+
+    def is_lost(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return bool(e is not None and getattr(e, "lost", False)
+                        and not e.ready)
+
+    def clear_lost(self, object_id: ObjectID):
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None:
+                e.lost = False
+
+    def entries_snapshot(self):
+        """(object_id, ready, size, local_refs, submitted_refs, spilled)
+        rows for the state API."""
+        with self._cv:
+            return [
+                (oid, e.ready, e.size, e.local_refs, e.submitted_refs,
+                 e.spilled_path is not None)
+                for oid, e in self._entries.items()
+            ]
 
     def _maybe_evict_locked(self, object_id: ObjectID, entry: _Entry):
         if (

@@ -193,6 +193,17 @@ class Worker:
         return ObjectRef(oid)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
+        if self.store.is_lost(ref.object_id):
+            # Lineage reconstruction (cluster mode): re-execute producers.
+            cluster = getattr(self, "cluster", None)
+            if cluster is not None and cluster.recover_object(ref.object_id):
+                self.store.clear_lost(ref.object_id)
+            else:
+                from ray_tpu.exceptions import ObjectLostError
+
+                raise ObjectLostError(
+                    f"object {ref.object_id.hex()[:16]}… lost and no "
+                    f"lineage is available to reconstruct it")
         serialized = self.store.get(ref.object_id, timeout=timeout)
         value = self.serialization_context.deserialize(serialized)
         if isinstance(value, RayTaskError):
@@ -213,7 +224,11 @@ class Worker:
                 for r in _refs:
                     self.store.remove_submitted_ref(r.object_id)
             self.store.on_ready(spec.return_ids[0], _release)
-        self.scheduler.submit(spec)
+        cluster = getattr(self, "cluster", None)
+        if cluster is not None:
+            cluster.submit(spec)
+        else:
+            self.scheduler.submit(spec)
         return refs
 
     # -------------------------------------------------------- internal KV ---

@@ -1,0 +1,132 @@
+"""Job submission (reference role: ray/job_submission — dashboard JobManager
+running entrypoints as subprocess drivers with status/log streaming)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+
+class JobStatus(str, Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: JobStatus
+    start_time: float
+    end_time: Optional[float] = None
+    return_code: Optional[int] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+class JobSubmissionClient:
+    """Local job manager: runs entrypoints as subprocess drivers with
+    captured logs under the session dir."""
+
+    def __init__(self, address: Optional[str] = None):
+        self._jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._logs_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "ray_tpu", "jobs")
+        os.makedirs(self._logs_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"raytpu_job_{uuid.uuid4().hex[:10]}"
+        env = dict(os.environ)
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update({k: str(v)
+                        for k, v in runtime_env["env_vars"].items()})
+        cwd = (runtime_env or {}).get("working_dir") or os.getcwd()
+        log_path = os.path.join(self._logs_dir, f"{job_id}.log")
+        log_f = open(log_path, "wb")
+        proc = subprocess.Popen(
+            entrypoint, shell=True, cwd=cwd, env=env,
+            stdout=log_f, stderr=subprocess.STDOUT)
+        info = JobInfo(job_id=job_id, entrypoint=entrypoint,
+                       status=JobStatus.RUNNING, start_time=time.time(),
+                       metadata=metadata or {})
+        with self._lock:
+            self._jobs[job_id] = info
+            self._procs[job_id] = proc
+
+        def reap():
+            rc = proc.wait()
+            log_f.close()
+            with self._lock:
+                info.end_time = time.time()
+                info.return_code = rc
+                if info.status != JobStatus.STOPPED:
+                    info.status = (JobStatus.SUCCEEDED if rc == 0
+                                   else JobStatus.FAILED)
+
+        threading.Thread(target=reap, daemon=True,
+                         name=f"job-reaper-{job_id}").start()
+        return job_id
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        with self._lock:
+            return self._jobs[job_id].status
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        with self._lock:
+            return self._jobs[job_id]
+
+    def get_job_logs(self, job_id: str) -> str:
+        path = os.path.join(self._logs_dir, f"{job_id}.log")
+        if not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def list_jobs(self) -> List[JobInfo]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def stop_job(self, job_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(job_id)
+            info = self._jobs.get(job_id)
+        if proc is None or proc.poll() is not None:
+            return False
+        info.status = JobStatus.STOPPED
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return True
+
+    def tail_job_logs(self, job_id: str):
+        """Generator yielding log chunks until the job finishes."""
+        path = os.path.join(self._logs_dir, f"{job_id}.log")
+        pos = 0
+        while True:
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    yield chunk.decode(errors="replace")
+            status = self.get_job_status(job_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                          JobStatus.STOPPED):
+                break
+            time.sleep(0.2)

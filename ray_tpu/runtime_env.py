@@ -1,0 +1,78 @@
+"""Runtime environments (reference role: ray/runtime_env + the per-node
+runtime-env agent [unverified]).
+
+Scope honest to this runtime: workers are in-process, so ``env_vars`` apply
+around task/actor execution (saved+restored), ``working_dir`` is copied to a
+session-scoped dir and prepended to sys.path, and ``py_modules`` paths are
+importable. Process-isolated envs (pip/conda/container) are declared but
+rejected loudly rather than silently ignored.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import sys
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional
+
+_UNSUPPORTED = ("pip", "conda", "container", "uv")
+_apply_lock = threading.Lock()
+
+
+class RuntimeEnv(dict):
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None, **kwargs):
+        bad = [k for k in kwargs if k in _UNSUPPORTED]
+        if bad:
+            raise ValueError(
+                f"runtime_env features {bad} need process-isolated workers; "
+                f"this runtime executes in-process (supported: env_vars, "
+                f"working_dir, py_modules)")
+        super().__init__(
+            env_vars=env_vars or {}, working_dir=working_dir,
+            py_modules=py_modules or [], **kwargs)
+        self._staged_dir: Optional[str] = None
+
+    def stage(self) -> "RuntimeEnv":
+        """Copy working_dir into a session dir (content-addressed caching is
+        the reference's URI scheme; local copy suffices in-process)."""
+        wd = self.get("working_dir")
+        if wd and self._staged_dir is None:
+            dst = tempfile.mkdtemp(prefix="ray_tpu_runtime_env_")
+            shutil.copytree(wd, os.path.join(dst, "working_dir"))
+            self._staged_dir = os.path.join(dst, "working_dir")
+        return self
+
+    @contextlib.contextmanager
+    def applied(self):
+        """Apply env_vars + import paths around an execution."""
+        with _apply_lock:
+            saved = {}
+            for k, v in self.get("env_vars", {}).items():
+                saved[k] = os.environ.get(k)
+                os.environ[k] = str(v)
+            added_paths = []
+            if self._staged_dir:
+                sys.path.insert(0, self._staged_dir)
+                added_paths.append(self._staged_dir)
+            for p in self.get("py_modules", []):
+                sys.path.insert(0, p)
+                added_paths.append(p)
+        try:
+            yield
+        finally:
+            with _apply_lock:
+                for k, old in saved.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+                for p in added_paths:
+                    try:
+                        sys.path.remove(p)
+                    except ValueError:
+                        pass

@@ -1,0 +1,116 @@
+"""CLI (reference role: ray/scripts/scripts.py — `ray status/list/
+microbenchmark/timeline/job`). argparse, no click dependency.
+
+Usage: python -m ray_tpu.scripts.cli <command> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu.util.state import (
+        summarize_actors,
+        summarize_objects,
+        summarize_tasks,
+    )
+
+    print(json.dumps({
+        "cluster_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+        "tasks": summarize_tasks(),
+        "actors": summarize_actors(),
+        "objects": summarize_objects(),
+    }, indent=2))
+
+
+def cmd_list(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu.util import state
+
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }[args.resource]
+    rows = fn(limit=args.limit)
+    for r in rows:
+        print(json.dumps(r.__dict__ if hasattr(r, "__dict__") else r,
+                         default=str))
+
+
+def cmd_timeline(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu.util.state import get_timeline
+
+    trace = get_timeline()
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {args.output}")
+
+
+def cmd_microbenchmark(args):
+    import subprocess
+
+    cmd = [sys.executable, "bench.py", "--all"]
+    raise SystemExit(subprocess.call(cmd))
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(job_id)
+        for chunk in client.tail_job_logs(job_id):
+            sys.stdout.write(chunk)
+        info = client.get_job_info(job_id)
+        print(f"job {job_id}: {info.status}")
+        raise SystemExit(0 if info.return_code == 0 else 1)
+    raise SystemExit(f"unknown job command {args.job_cmd!r}")
+
+
+def cmd_version(args):
+    import ray_tpu
+
+    print(ray_tpu.__version__)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+    p = sub.add_parser("list")
+    p.add_argument("resource", choices=[
+        "tasks", "actors", "objects", "placement-groups"])
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("timeline")
+    p.add_argument("--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+    sub.add_parser("microbenchmark").set_defaults(fn=cmd_microbenchmark)
+    p = sub.add_parser("job")
+    p.add_argument("job_cmd", choices=["submit"])
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_job)
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

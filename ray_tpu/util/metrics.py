@@ -1,0 +1,185 @@
+"""User + framework metrics with Prometheus text exposition.
+
+Reference role: ray/util/metrics.py (user API) + src/ray/stats/ +
+the per-node metrics agent's Prometheus endpoint (SURVEY.md §2.7). One
+process-global registry; ``export_prometheus()`` renders text format 0.0.4;
+``serve_metrics()`` exposes /metrics over stdlib HTTP.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        self._default_tags: Dict[str, str] = {}
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"metric {self.name}: undeclared tag keys {sorted(extra)}")
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def _samples(self):
+        with self._lock:
+            return dict(self._values)
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [
+            0.001, 0.01, 0.1, 1.0, 10.0, 100.0]
+        self._buckets: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._counts: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            buckets[idx] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def _samples(self):
+        with self._lock:
+            return {
+                k: (list(v), self._sums.get(k, 0.0), self._counts.get(k, 0))
+                for k, v in self._buckets.items()
+            }
+
+
+def _fmt_tags(keys, values) -> str:
+    if not keys:
+        return ""
+    pairs = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    return "{" + pairs + "}"
+
+
+def export_prometheus() -> str:
+    """Render every registered metric in Prometheus text format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.metric_type}")
+        if isinstance(m, Histogram):
+            for k, (buckets, total, count) in m._samples().items():
+                cum = 0
+                for b, n in zip(m.boundaries, buckets):
+                    cum += n
+                    tag = _fmt_tags(m.tag_keys + ("le",),
+                                    k + (str(b),))
+                    lines.append(f"{m.name}_bucket{tag} {cum}")
+                cum += buckets[-1]
+                tag = _fmt_tags(m.tag_keys + ("le",), k + ("+Inf",))
+                lines.append(f"{m.name}_bucket{tag} {cum}")
+                lines.append(
+                    f"{m.name}_sum{_fmt_tags(m.tag_keys, k)} {total}")
+                lines.append(
+                    f"{m.name}_count{_fmt_tags(m.tag_keys, k)} {count}")
+        else:
+            for k, v in m._samples().items():
+                lines.append(f"{m.name}{_fmt_tags(m.tag_keys, k)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry():
+    with _registry_lock:
+        _registry.clear()
+
+
+_server = None
+
+
+def serve_metrics(host: str = "127.0.0.1", port: int = 0):
+    """Expose /metrics (Prometheus scrape endpoint; reference: per-node
+    metrics agent). Returns (host, port)."""
+    global _server
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = export_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    _server = http.server.ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="metrics-exporter")
+    t.start()
+    return _server.server_address
+
+
+def stop_metrics_server():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None

@@ -1,0 +1,133 @@
+"""Placement groups (reference role: ray/util/placement_group.py + the GCS
+placement-group manager's 2-phase reserve [unverified]).
+
+A placement group atomically reserves resource bundles. On the single-node
+runtime all bundles reserve against the local pool; on the cluster
+simulation (cluster_utils) bundles map to nodes per strategy:
+PACK/STRICT_PACK prefer one node, SPREAD/STRICT_SPREAD distinct nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu._private.worker import auto_init
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self._ready = threading.Event()
+        self._removed = False
+        self.bundle_nodes: List[Optional[str]] = [None] * len(bundles)
+
+    def ready(self):
+        """ObjectRef-like: blocks via ray_tpu.get(pg.ready())."""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _pg_ready(pg_id):
+            worker = auto_init()
+            pg = worker.placement_groups.get(pg_id)
+            if pg is None:
+                raise ValueError(f"placement group {pg_id} removed")
+            pg._ready.wait(timeout=30)
+            return True
+
+        return _pg_ready.remote(self.id)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return self._ready.wait(timeout=timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self.bundles)
+
+    def __repr__(self):
+        return (f"PlacementGroup(id={self.id[:8]}…, "
+                f"strategy={self.strategy}, bundles={self.bundles})")
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be non-empty resource dicts")
+    worker = auto_init()
+    pg = PlacementGroup(uuid.uuid4().hex, [dict(b) for b in bundles],
+                        strategy, name)
+    cluster = getattr(worker, "cluster", None)
+    if cluster is not None:
+        cluster.reserve_placement_group(pg)
+    else:
+        # Single node: every bundle reserves locally; strict-spread across
+        # >1 bundle cannot be honored on one node.
+        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+            raise ValueError(
+                "STRICT_SPREAD needs one node per bundle; single-node "
+                "runtime has 1 (start a cluster fixture for multi-node)")
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        if not worker.resource_pool.fits(total):
+            raise ValueError(
+                f"placement group demand {total} exceeds cluster total "
+                f"{worker.resource_pool.total}")
+        if not worker.resource_pool.try_acquire(total):
+            # Infeasible now: stays pending (ready() blocks); reference
+            # behavior for unsatisfiable-but-feasible groups is to wait.
+            pg._pending_demand = total
+        else:
+            pg._reserved = total
+            pg._ready.set()
+    worker.placement_groups[pg.id] = pg
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    worker = auto_init()
+    stored = worker.placement_groups.pop(pg.id, None)
+    if stored is None:
+        return
+    stored._removed = True
+    reserved = getattr(stored, "_reserved", None)
+    if reserved:
+        worker.resource_pool.release(reserved)
+    cluster = getattr(worker, "cluster", None)
+    if cluster is not None:
+        cluster.release_placement_group(stored)
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    worker = auto_init()
+    for pg in worker.placement_groups.values():
+        if pg.name == name:
+            return pg
+    raise ValueError(f"no placement group named {name!r}")
+
+
+def placement_group_table() -> Dict[str, dict]:
+    worker = auto_init()
+    return {
+        pg.id: {
+            "name": pg.name,
+            "strategy": pg.strategy,
+            "bundles": pg.bundles,
+            "state": ("REMOVED" if pg._removed else
+                      "CREATED" if pg._ready.is_set() else "PENDING"),
+        }
+        for pg in worker.placement_groups.values()
+    }

@@ -1,0 +1,132 @@
+"""State API (reference role: ray/util/state — `ray list tasks/actors/...`,
+summaries; backed there by GCS task events, here by the in-process
+task-event buffer + worker registries)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import global_worker
+
+
+@dataclass
+class TaskState:
+    task_id: str
+    name: str
+    state: str
+    duration_s: Optional[float]
+
+
+@dataclass
+class ActorState:
+    actor_id: str
+    class_name: str
+    name: Optional[str]
+    state: str
+    num_restarts: int
+
+
+@dataclass
+class ObjectState:
+    object_id: str
+    ready: bool
+    size_bytes: int
+    local_refs: int
+    submitted_refs: int
+    spilled: bool
+
+
+def list_tasks(filters: Optional[List] = None,
+               limit: int = 1000) -> List[TaskState]:
+    worker = global_worker()
+    out: List[TaskState] = []
+    for ev in worker.task_events.list_tasks(limit=limit * 4):
+        st = TaskState(task_id=ev.task_id.hex(), name=ev.name,
+                       state=ev.state, duration_s=ev.duration)
+        if _matches(st, filters):
+            out.append(st)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_actors(filters: Optional[List] = None,
+                limit: int = 1000) -> List[ActorState]:
+    worker = global_worker()
+    out = []
+    for actor_id, runtime in list(worker.actors.items()):
+        st = ActorState(
+            actor_id=actor_id.hex(), class_name=runtime.class_name,
+            name=runtime.actor_name,
+            state="DEAD" if runtime.dead else "ALIVE",
+            num_restarts=runtime.restarts_used)
+        if _matches(st, filters):
+            out.append(st)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_objects(filters: Optional[List] = None,
+                 limit: int = 1000) -> List[ObjectState]:
+    worker = global_worker()
+    out = []
+    for oid, ready, size, lrefs, srefs, spilled in (
+            worker.store.entries_snapshot()):
+        st = ObjectState(object_id=oid.hex(), ready=ready, size_bytes=size,
+                         local_refs=lrefs, submitted_refs=srefs,
+                         spilled=spilled)
+        if _matches(st, filters):
+            out.append(st)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+    from ray_tpu.util.placement_group import placement_group_table
+
+    return list(placement_group_table().values())[:limit]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    return global_worker().task_events.summary()
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for a in list_actors():
+        counts[a.state] = counts.get(a.state, 0) + 1
+    return counts
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = global_worker().store.entries_snapshot()
+    return {
+        "num_objects": len(rows),
+        "num_ready": sum(1 for r in rows if r[1]),
+        "total_bytes": sum(r[2] for r in rows),
+        "num_spilled": sum(1 for r in rows if r[5]),
+    }
+
+
+def get_timeline() -> List[dict]:
+    """Chrome-tracing events (`ray timeline` parity)."""
+    return global_worker().task_events.to_chrome_trace()
+
+
+def _matches(item, filters) -> bool:
+    if not filters:
+        return True
+    for key, op, value in filters:
+        actual = getattr(item, key, None)
+        if op in ("=", "=="):
+            if str(actual) != str(value):
+                return False
+        elif op == "!=":
+            if str(actual) == str(value):
+                return False
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return True

@@ -1,0 +1,158 @@
+"""Multi-node simulation tests (reference model: tests on cluster_utils
+fixtures — scheduling policies, placement groups, node-failure fault
+tolerance, lineage reconstruction)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture
+def cluster(ray_start_regular):
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_tasks_run_across_nodes(cluster):
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    out = ray_tpu.get([f.remote(i) for i in range(20)])
+    assert out == [i * 2 for i in range(20)]
+
+
+def test_hybrid_policy_packs_then_spreads(cluster):
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold():
+        time.sleep(0.3)
+        return True
+
+    refs = [hold.remote() for _ in range(4)]
+    time.sleep(0.1)
+    # With 2 nodes x 2 CPUs and 4 one-CPU tasks, both nodes must be in use
+    # (pack first node to the threshold, then spill to the second).
+    heads_util = cluster.head_node.resource_pool.utilization()
+    n2_util = n2.resource_pool.utilization()
+    assert heads_util > 0 and n2_util > 0
+    assert all(ray_tpu.get(refs))
+
+
+def test_node_affinity_strategy(cluster):
+    target = cluster.add_node(num_cpus=1, resources={"special": 1.0})
+
+    @ray_tpu.remote
+    def where():
+        return True
+
+    ref = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=target.hex())).remote()
+    assert ray_tpu.get(ref)
+    assert cluster._task_node[ref.task_id()] is target
+
+
+def test_custom_resource_routes_to_owning_node(cluster):
+    gpu_node = cluster.add_node(num_cpus=1, resources={"accel": 2.0})
+
+    @ray_tpu.remote(resources={"accel": 1.0})
+    def use_accel():
+        return "ok"
+
+    ref = use_accel.remote()
+    assert ray_tpu.get(ref) == "ok"
+    assert cluster._task_node[ref.task_id()] is gpu_node
+
+
+def test_infeasible_task_raises(cluster):
+    @ray_tpu.remote(resources={"nonexistent": 1.0})
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(f.remote(), timeout=5)
+
+
+def test_placement_group_strict_spread(cluster):
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(5)
+    assert len(set(pg.bundle_nodes)) == 3
+
+    @ray_tpu.remote(num_cpus=0)
+    def pinned():
+        return 7
+
+    ref = pinned.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=1)).remote()
+    assert ray_tpu.get(ref) == 7
+    assert cluster._task_node[ref.task_id()].hex() == pg.bundle_nodes[1]
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_pack_one_node(cluster):
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(5)
+    assert len(set(pg.bundle_nodes)) == 1
+    remove_placement_group(pg)
+
+
+def test_node_failure_retries_on_other_node(cluster):
+    victim = cluster.add_node(num_cpus=4)
+    started = []
+
+    # Soft affinity pins the first attempt to the victim; after the node
+    # dies the retry is free to land anywhere.
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def slow2():
+        started.append(1)
+        time.sleep(0.5)
+        return "survived"
+
+    ref = slow2.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=victim.hex(), soft=True)).remote()
+    time.sleep(0.15)  # let it start on the victim
+    cluster.remove_node(victim, lose_objects=False)
+    assert ray_tpu.get(ref, timeout=10) == "survived"
+    assert len(started) >= 2  # re-executed
+
+
+def test_lineage_reconstruction_after_object_loss(cluster):
+    node = cluster.add_node(num_cpus=2, resources={"mem_node": 2.0})
+    runs = []
+
+    @ray_tpu.remote(resources={"mem_node": 0.5})
+    def produce():
+        runs.append(1)
+        return 41
+
+    @ray_tpu.remote
+    def consume(x):
+        return x + 1
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref)) == 42
+    assert len(runs) == 1
+    # Lose the node (and the object it produced); next get reconstructs.
+    cluster.add_node(num_cpus=2, resources={"mem_node": 2.0})
+    cluster.remove_node(node, lose_objects=True)
+    assert ray_tpu.get(consume.remote(ref)) == 42
+    assert len(runs) == 2  # producer re-executed from lineage

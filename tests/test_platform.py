@@ -1,0 +1,160 @@
+"""Platform surface tests: placement groups (single node), state API,
+metrics/Prometheus, timeline, runtime_env, job submission, CLI."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util import metrics as rm
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+def test_placement_group_single_node_reserve_release():
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(5)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 2.0  # 4 total - 2 reserved
+    remove_placement_group(pg)
+    assert ray_tpu.available_resources()["CPU"] == 4.0
+
+
+def test_placement_group_infeasible_raises():
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 100}])
+
+
+def test_state_api_lists():
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(5)])
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+
+    tasks = state.list_tasks()
+    assert any(t.name == "f" and t.state == "FINISHED" for t in tasks)
+    actors = state.list_actors()
+    assert any(x.class_name == "A" and x.state == "ALIVE" for x in actors)
+    objs = state.list_objects()
+    assert len(objs) >= 5
+    summary = state.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 5
+    filtered = state.list_tasks(filters=[("state", "=", "FINISHED")])
+    assert all(t.state == "FINISHED" for t in filtered)
+
+
+def test_timeline_chrome_trace():
+    from ray_tpu.util.state import get_timeline
+
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+    trace = get_timeline()
+    assert len(trace) >= 3
+    ev = trace[0]
+    assert ev["ph"] == "X" and "ts" in ev and "dur" in ev
+
+
+def test_metrics_prometheus_export():
+    rm.clear_registry()
+    c = rm.Counter("test_requests_total", "requests", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    c.inc(1, tags={"route": "/b"})
+    g = rm.Gauge("test_inflight", "in flight")
+    g.set(7)
+    h = rm.Histogram("test_latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = rm.export_prometheus()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_inflight 7.0" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1' in text
+    assert 'test_latency_s_bucket{le="+Inf"} 3' in text
+    assert "test_latency_s_count 3" in text
+
+
+def test_metrics_http_endpoint():
+    rm.clear_registry()
+    rm.Gauge("scrape_me", "").set(42)
+    host, port = rm.serve_metrics(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert "scrape_me 42.0" in body
+    finally:
+        rm.stop_metrics_server()
+
+
+def test_runtime_env_env_vars_and_unsupported():
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    env = RuntimeEnv(env_vars={"RAY_TPU_TEST_VAR": "on"})
+    assert os.environ.get("RAY_TPU_TEST_VAR") is None
+    with env.applied():
+        assert os.environ["RAY_TPU_TEST_VAR"] == "on"
+    assert os.environ.get("RAY_TPU_TEST_VAR") is None
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["requests"])
+
+
+def test_job_submission_lifecycle(tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok')\"")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get_job_status(job_id) in (JobStatus.SUCCEEDED,
+                                             JobStatus.FAILED):
+            break
+        time.sleep(0.1)
+    assert client.get_job_status(job_id) == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(job_id)
+
+    bad = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"raise SystemExit(3)\"")
+    while client.get_job_status(bad) == JobStatus.RUNNING:
+        time.sleep(0.1)
+    assert client.get_job_status(bad) == JobStatus.FAILED
+
+
+def test_cli_status_and_list(capsys):
+    from ray_tpu.scripts.cli import main
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    main(["status"])
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert "cluster_resources" in data and "tasks" in data
+    main(["list", "tasks", "--limit", "5"])
+    out = capsys.readouterr().out
+    assert "FINISHED" in out
