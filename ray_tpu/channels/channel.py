@@ -105,16 +105,30 @@ class BufferedChannel(Channel):
         self._w = 0
         self._r = [0] * num_readers
         self._lock = threading.Lock()
+        self._wlock = threading.Lock()
 
     def write(self, value: Any, timeout: Optional[float] = None):
-        # Cursor advances only after the slot op succeeds, so a
+        # The writer mutex spans slot selection AND the slot write: with
+        # only the cursor under a lock, two concurrent writers could select
+        # the same slot and both advance _w, leaving a never-written slot
+        # that readers block on forever. The lock acquire itself is bounded
+        # by the same deadline so a second writer's timeout is honored even
+        # while the first holds the lock blocked on stalled readers. The
+        # cursor still advances only after the slot op succeeds, so a
         # ChannelTimeoutError leaves the ring consistent and the caller can
         # simply retry (compiled_dag relies on this).
-        with self._lock:
+        timeout = (GlobalConfig.channel_read_timeout_s
+                   if timeout is None else timeout)
+        deadline = time.monotonic() + timeout
+        if not self._wlock.acquire(timeout=timeout):
+            raise ChannelTimeoutError(
+                "write blocked: another writer holds the channel")
+        try:
             slot = self._slots[self._w % len(self._slots)]
-        slot.write(value, timeout)
-        with self._lock:
+            slot.write(value, max(0.0, deadline - time.monotonic()))
             self._w += 1
+        finally:
+            self._wlock.release()
 
     def read(self, reader_id: int = 0, timeout: Optional[float] = None):
         with self._lock:

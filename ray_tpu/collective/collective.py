@@ -50,9 +50,15 @@ class _Group:
             else:
                 if not self._cv.wait_for(
                         lambda: self._round > my_round, timeout=timeout):
+                    # Withdraw this rank's contribution (if the round has
+                    # not advanced) so a later collective on the group
+                    # doesn't complete early with a stale value.
+                    if (self._round == my_round
+                            and self._contrib.get(rank) is value):
+                        del self._contrib[rank]
                     raise TimeoutError(
                         f"collective on group {self.name!r}: only "
-                        f"{len(self._contrib)}/{self.world_size} ranks "
+                        f"{len(self._contrib) + 1}/{self.world_size} ranks "
                         f"arrived within {timeout}s")
             return self._result
 

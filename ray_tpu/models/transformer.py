@@ -71,10 +71,14 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     """Stacked-layer param pytree. Weights f32 (master copy)."""
     D, F, Hd = cfg.d_model, cfg.d_ff, cfg.head_dim
     nq, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
-    ks = jax.random.split(key, 10)
+    # One distinct key per weight family: same-shaped families (wq/wk/wv,
+    # w_gate/w_up, e_gate/e_up) must not share init, or attention/MLP
+    # branches start out identical and training silently degrades.
+    ks = jax.random.split(key, 16)
+    _next_family = iter(range(2, 16))
 
     def stack(initfn):
-        keys = jax.random.split(ks[9], L)
+        keys = jax.random.split(ks[next(_next_family)], L)
         return jax.vmap(initfn)(keys)
 
     layers = {

@@ -74,7 +74,7 @@ class RemoteFunction:
             num_returns=num_returns,
             return_ids=return_ids,
             name=opts.get("name") or getattr(
-                self._function, "__qualname__", "task"),
+                self._function, "__name__", "task"),
             resources=_normalize_resources(opts),
             max_retries=max_retries,
             retry_exceptions=bool(opts.get("retry_exceptions", False)),

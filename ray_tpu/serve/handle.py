@@ -13,10 +13,14 @@ class DeploymentResponse:
     """Future for one routed request; passing it as an argument to another
     handle call chains without blocking (resolved at dispatch)."""
 
-    def __init__(self, ref, replica_set, replica_idx):
+    def __init__(self, ref, replica_set, replica_idx, replica=None):
         self._ref = ref
         self._rs = replica_set
         self._idx = replica_idx
+        # Strong ref for the life of the in-flight key: the router keys
+        # counts by id(replica), so the object must not be GC'd (and its id
+        # recycled) while this response is pending.
+        self._replica = replica
         self._released = False
         self._lock = threading.Lock()
 
@@ -31,6 +35,7 @@ class DeploymentResponse:
             if not self._released:
                 self._released = True
                 self._rs.release(self._idx)
+                self._replica = None
 
     def _to_object_ref(self):
         return self._ref
@@ -70,7 +75,7 @@ class DeploymentHandle:
         }
         method = getattr(replica, "handle_request")
         ref = method.remote(self._method, args, kwargs)
-        resp = DeploymentResponse(ref, rs, idx)
+        resp = DeploymentResponse(ref, rs, idx, replica=replica)
         self._controller._record_request(self._name)
         return resp
 

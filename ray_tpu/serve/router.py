@@ -13,6 +13,10 @@ class ReplicaSet:
 
     def __init__(self):
         self._replicas: List[Any] = []
+        # In-flight counts keyed by replica identity, not list index:
+        # after update() replaces/removes replicas, index-keyed counts would
+        # transfer to whichever replica now occupies that slot and skew the
+        # power-of-two choice.
         self._inflight: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._rng = random.Random(0)
@@ -20,9 +24,9 @@ class ReplicaSet:
     def update(self, replicas: List[Any]):
         with self._lock:
             self._replicas = list(replicas)
+            live = {id(r) for r in replicas}
             self._inflight = {
-                i: self._inflight.get(i, 0)
-                for i in range(len(replicas))
+                k: v for k, v in self._inflight.items() if k in live
             }
 
     def size(self) -> int:
@@ -31,24 +35,30 @@ class ReplicaSet:
 
     def choose(self) -> (int, Any):
         """Power of two choices: sample two replicas, pick the one with the
-        shorter queue. Falls back to the single replica when size==1."""
+        shorter queue. Falls back to the single replica when size==1.
+
+        Returns (key, replica); pass the key back to release()."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas available")
             if n == 1:
-                idx = 0
+                replica = self._replicas[0]
             else:
                 a, b = self._rng.sample(range(n), 2)
-                idx = a if self._inflight[a] <= self._inflight[b] else b
-            self._inflight[idx] += 1
-            return idx, self._replicas[idx]
+                ra, rb = self._replicas[a], self._replicas[b]
+                qa = self._inflight.get(id(ra), 0)
+                qb = self._inflight.get(id(rb), 0)
+                replica = ra if qa <= qb else rb
+            key = id(replica)
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+            return key, replica
 
-    def release(self, idx: int):
+    def release(self, key: int):
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if self._inflight.get(key, 0) > 0:
+                self._inflight[key] -= 1
 
     def queue_lengths(self) -> List[int]:
         with self._lock:
-            return [self._inflight[i] for i in range(len(self._replicas))]
+            return [self._inflight.get(id(r), 0) for r in self._replicas]

@@ -40,7 +40,8 @@ def test_state_api_lists():
     def f():
         return 1
 
-    ray_tpu.get([f.remote() for _ in range(5)])
+    refs = [f.remote() for _ in range(5)]
+    ray_tpu.get(refs)
 
     @ray_tpu.remote
     class A:
