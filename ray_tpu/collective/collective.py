@@ -50,6 +50,7 @@ class _Group:
             else:
                 if not self._cv.wait_for(
                         lambda: self._round > my_round, timeout=timeout):
+                    arrived = len(self._contrib)
                     # Withdraw this rank's contribution (if the round has
                     # not advanced) so a later collective on the group
                     # doesn't complete early with a stale value.
@@ -58,7 +59,7 @@ class _Group:
                         del self._contrib[rank]
                     raise TimeoutError(
                         f"collective on group {self.name!r}: only "
-                        f"{len(self._contrib) + 1}/{self.world_size} ranks "
+                        f"{arrived}/{self.world_size} ranks "
                         f"arrived within {timeout}s")
             return self._result
 

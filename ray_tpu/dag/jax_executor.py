@@ -23,8 +23,16 @@ whole DAG into a single XLA program:
   in-degrees with a segment-sum over the edge list — ObjectRef dependency
   resolution as sparse ops, no host round-trips per wave.
 
-Multi-chip: the object table can be sharded over a Mesh axis; cross-shard
-edges then lower to XLA collectives on ICI (see ray_tpu/parallel).
+Multi-chip (``mesh=``): task waves are partitioned over a Mesh axis with
+``shard_map`` — each shard executes its slice of every wave against its own
+copy of the object table, and the wave's outputs are exchanged with a single
+``lax.all_gather`` riding ICI, which is how cross-shard dependency edges
+lower to collectives. The schedule split is static (lane ``j`` of a wave
+runs on shard ``j // (W/n)``), so a fan-out of 10k tasks runs 10k/n per
+chip and the only per-wave communication is one collective over the wave's
+output payloads. The dynamic frontier mode shards the masked task list the
+same way (task ``ci`` owned by shard ``ci // (C/n)``), with the in-degree
+vector and done mask kept replicated.
 """
 
 from __future__ import annotations
@@ -67,7 +75,8 @@ class JaxDAGRef:
 class CompiledJaxDAG:
     def __init__(self, fn, num_inputs: int, multi_output: bool,
                  num_tasks: int, num_waves: int, wave_width: int,
-                 payload_shape, dtype, dynamic: bool, op_names: List[str]):
+                 payload_shape, dtype, dynamic: bool, op_names: List[str],
+                 num_shards: int = 1):
         self._fn = fn
         self.num_inputs = num_inputs
         self.multi_output = multi_output
@@ -78,6 +87,7 @@ class CompiledJaxDAG:
         self.dtype = dtype
         self.dynamic = dynamic
         self.op_names = op_names
+        self.num_shards = num_shards
 
     def execute(self, *inputs) -> JaxDAGRef:
         if len(inputs) != self.num_inputs:
@@ -100,9 +110,11 @@ class CompiledJaxDAG:
         """API parity with the actor-loop backend; nothing to stop here."""
 
     def visualize_schedule(self) -> str:
+        shards = (f", sharded ×{self.num_shards}" if self.num_shards > 1
+                  else "")
         return (
             f"CompiledJaxDAG: {self.num_tasks} tasks, "
-            f"{self.num_waves} waves × width {self.wave_width}, "
+            f"{self.num_waves} waves × width {self.wave_width}{shards}, "
             f"{'dynamic frontier' if self.dynamic else 'static levels'}, "
             f"payload {self.payload_shape} {jnp.dtype(self.dtype).name}, "
             f"ops {self.op_names}"
@@ -116,17 +128,37 @@ def compile_jax_dag(
     dynamic: Optional[bool] = None,
     max_args: Optional[int] = None,
     fuse: bool = True,
+    mesh=None,
+    mesh_axis: Optional[str] = None,
 ) -> CompiledJaxDAG:
     """Lower a static DAG of jax-traceable FunctionNodes to one XLA program.
 
     Every task op must map payload-shaped arrays to one payload-shaped array
     (uniform buckets; heterogeneous payloads belong in separate compiled
     graphs or the actor backend — see SURVEY.md §7 'hard parts').
+
+    With ``mesh=`` (a ``jax.sharding.Mesh``), execution is partitioned over
+    ``mesh_axis`` (default: the mesh's first axis of size > 1): each shard
+    runs its slice of every wave and the wave's outputs cross shards via
+    one ``lax.all_gather`` per wave — the multi-chip north-star path.
     """
     if dynamic is None:
         dynamic = GlobalConfig.wave_executor_dynamic
     if max_args is None:
         max_args = GlobalConfig.wave_executor_max_args
+
+    n_sh = 1
+    if mesh is not None:
+        if mesh_axis is None:
+            mesh_axis = next(
+                (a for a in mesh.axis_names if mesh.shape[a] > 1),
+                mesh.axis_names[0])
+        if mesh_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh has no axis {mesh_axis!r}; axes: {mesh.axis_names}")
+        n_sh = mesh.shape[mesh_axis]
+        if n_sh == 1:
+            mesh = None  # degenerate: single-shard fall-through
 
     order = leaf.topological_order()
 
@@ -374,8 +406,8 @@ def compile_jax_dag(
     out_slots_dev = jnp.asarray(out_slots)
     op_ids_dev = jnp.asarray(op_ids)
 
-    def _run_tasks(obj, t_idx):
-        """Execute tasks t_idx (int32 [W], -1 = padding) against obj table."""
+    def _compute_tasks(obj, t_idx):
+        """Run tasks t_idx (int32 [W], -1 = padding) → outputs [W, *P]."""
         valid = t_idx >= 0
         t = jnp.where(valid, t_idx, 0)
         a_slots = arg_slots_dev[t]                      # [W, A]
@@ -386,6 +418,13 @@ def compile_jax_dag(
             ops = op_ids_dev[t]
             outs = jax.vmap(
                 lambda o, s: lax.switch(o, branches, s))(ops, stacked)
+        return outs
+
+    def _run_tasks(obj, t_idx):
+        """Execute tasks t_idx and scatter outputs into the obj table."""
+        outs = _compute_tasks(obj, t_idx)
+        valid = t_idx >= 0
+        t = jnp.where(valid, t_idx, 0)
         slots = jnp.where(valid, out_slots_dev[t], scratch_slot)
         return obj.at[slots].set(outs)
 
@@ -410,20 +449,72 @@ def compile_jax_dag(
         sched = np.full((num_waves, wave_width), -1, np.int32)
         for wi, w in enumerate(waves):
             sched[wi, : len(w)] = w
-        sched_dev = jnp.asarray(sched)
 
-        def program(inputs):
-            obj = jnp.zeros((num_slots,) + payload_shape, dtype)
-            if num_inputs:
-                obj = obj.at[:num_inputs].set(inputs)
-            if num_waves == 1:
-                obj = _run_tasks(obj, sched_dev[0])
-            else:
-                obj = lax.fori_loop(
-                    0, num_waves,
-                    lambda w, o: _run_tasks(o, sched_dev[w]), obj)
-            out = obj[jnp.asarray(leaf_slots)]
-            return out if multi_output else out[0]
+        if mesh is None:
+            sched_dev = jnp.asarray(sched)
+
+            def program(inputs):
+                obj = jnp.zeros((num_slots,) + payload_shape, dtype)
+                if num_inputs:
+                    obj = obj.at[:num_inputs].set(inputs)
+                if num_waves == 1:
+                    obj = _run_tasks(obj, sched_dev[0])
+                else:
+                    obj = lax.fori_loop(
+                        0, num_waves,
+                        lambda w, o: _run_tasks(o, sched_dev[w]), obj)
+                out = obj[jnp.asarray(leaf_slots)]
+                return out if multi_output else out[0]
+
+        else:
+            # ---- mesh-sharded static waves ----------------------------------
+            # Pad wave width to a multiple of n_sh; shard j owns lanes
+            # [j*Wn, (j+1)*Wn) of every wave. Output slots per lane are
+            # static, so after the per-wave all_gather every shard applies
+            # the identical scatter to its table copy.
+            from jax.sharding import PartitionSpec as P
+
+            Wn = -(-wave_width // n_sh)
+            W_pad = Wn * n_sh
+            sched_pad = np.full((num_waves, W_pad), -1, np.int32)
+            sched_pad[:, :wave_width] = sched
+            wave_slots = np.full((num_waves, W_pad), scratch_slot, np.int32)
+            for wi in range(num_waves):
+                for j in range(W_pad):
+                    ci = sched_pad[wi, j]
+                    if ci >= 0:
+                        wave_slots[wi, j] = out_slots[ci]
+            sched_sharded = jnp.asarray(
+                sched_pad.reshape(num_waves, n_sh, Wn))
+            wave_slots_dev = jnp.asarray(wave_slots)
+            wave_width = W_pad
+
+            def _sharded_static(inputs, sched_local):
+                sched_local = sched_local[:, 0]          # [num_waves, Wn]
+                obj = jnp.zeros((num_slots,) + payload_shape, dtype)
+                if num_inputs:
+                    obj = obj.at[:num_inputs].set(inputs)
+
+                def wave(w, o):
+                    outs = _compute_tasks(o, sched_local[w])   # [Wn, *P]
+                    gathered = lax.all_gather(
+                        outs, mesh_axis, axis=0, tiled=True)   # [W_pad, *P]
+                    return o.at[wave_slots_dev[w]].set(gathered)
+
+                if num_waves == 1:
+                    obj = wave(0, obj)
+                else:
+                    obj = lax.fori_loop(0, num_waves, wave, obj)
+                out = obj[jnp.asarray(leaf_slots)]
+                return out if multi_output else out[0]
+
+            sharded_fn = jax.jit(jax.shard_map(
+                _sharded_static, mesh=mesh,
+                in_specs=(P(), P(None, mesh_axis, None)),
+                out_specs=P(), check_vma=False))
+
+            def program(inputs):
+                return sharded_fn(inputs, sched_sharded)
 
     else:
         # ---- dynamic frontier (lax.while_loop) ------------------------------
@@ -444,39 +535,102 @@ def compile_jax_dag(
         num_waves = 0  # unknown statically
         wave_width = C
 
-        def program(inputs):
-            obj = jnp.zeros((num_slots,) + payload_shape, dtype)
-            if num_inputs:
-                obj = obj.at[:num_inputs].set(inputs)
-            indeg = jnp.asarray(indeg0)
-            done = jnp.zeros(C, bool)
+        if mesh is None:
+            def program(inputs):
+                obj = jnp.zeros((num_slots,) + payload_shape, dtype)
+                if num_inputs:
+                    obj = obj.at[:num_inputs].set(inputs)
+                indeg = jnp.asarray(indeg0)
+                done = jnp.zeros(C, bool)
 
-            def cond(state):
-                _, _, done = state
-                return ~jnp.all(done)
+                def cond(state):
+                    _, _, done = state
+                    return ~jnp.all(done)
 
-            def body(state):
-                obj, indeg, done = state
-                ready = (indeg == 0) & ~done
-                t_idx = jnp.where(ready, all_tasks, -1)
-                obj = _run_tasks(obj, t_idx)
-                done = done | ready
-                # Frontier expansion: decrement consumers of finished
-                # producers via a segment-sum over the edge list.
-                if e_src.shape[0]:
-                    fired = ready[e_src].astype(jnp.int32)
-                    indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
-                        fired)
-                return obj, indeg, done
+                def body(state):
+                    obj, indeg, done = state
+                    ready = (indeg == 0) & ~done
+                    t_idx = jnp.where(ready, all_tasks, -1)
+                    obj = _run_tasks(obj, t_idx)
+                    done = done | ready
+                    # Frontier expansion: decrement consumers of finished
+                    # producers via a segment-sum over the edge list.
+                    if e_src.shape[0]:
+                        fired = ready[e_src].astype(jnp.int32)
+                        indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
+                            fired)
+                    return obj, indeg, done
 
-            obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
-            out = obj[jnp.asarray(leaf_slots)]
-            return out if multi_output else out[0]
+                obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
+                out = obj[jnp.asarray(leaf_slots)]
+                return out if multi_output else out[0]
 
-    fn = jax.jit(program)
+        else:
+            # ---- mesh-sharded dynamic frontier ------------------------------
+            # Task ci is owned by shard ci // Cn (contiguous blocks, padded
+            # to C_pad = Cn*n_sh). The in-degree vector and done mask stay
+            # replicated; each iteration a shard executes ready ∩ owned
+            # masked, and the frontier's outputs cross shards via one
+            # all_gather.
+            from jax.sharding import PartitionSpec as P
+
+            Cn = -(-C // n_sh)
+            C_pad = Cn * n_sh
+            out_slots_pad = np.full(C_pad, scratch_slot, np.int32)
+            out_slots_pad[:C] = out_slots
+            indeg0_pad = np.zeros(C_pad, np.int32)
+            indeg0_pad[:C] = indeg0
+            done0_pad = np.zeros(C_pad, bool)
+            done0_pad[C:] = True  # padding tasks are born finished
+            ids_sharded = jnp.asarray(
+                np.arange(C_pad, dtype=np.int32).reshape(n_sh, Cn))
+            out_slots_pad_dev = jnp.asarray(out_slots_pad)
+
+            def _sharded_dynamic(inputs, my_ids):
+                my_ids = my_ids[0]                       # [Cn]
+                obj = jnp.zeros((num_slots,) + payload_shape, dtype)
+                if num_inputs:
+                    obj = obj.at[:num_inputs].set(inputs)
+                indeg = jnp.asarray(indeg0_pad)
+                done = jnp.asarray(done0_pad)
+
+                def cond(state):
+                    _, _, done = state
+                    return ~jnp.all(done)
+
+                def body(state):
+                    obj, indeg, done = state
+                    ready = (indeg == 0) & ~done         # [C_pad]
+                    t_idx = jnp.where(ready[my_ids], my_ids, -1)
+                    outs = _compute_tasks(obj, t_idx)    # [Cn, *P]
+                    gathered = lax.all_gather(
+                        outs, mesh_axis, axis=0, tiled=True)  # [C_pad, *P]
+                    slots = jnp.where(ready, out_slots_pad_dev, scratch_slot)
+                    obj = obj.at[slots].set(gathered)
+                    done = done | ready
+                    if e_src.shape[0]:
+                        fired = ready[e_src].astype(jnp.int32)
+                        indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
+                            fired)
+                    return obj, indeg, done
+
+                obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
+                out = obj[jnp.asarray(leaf_slots)]
+                return out if multi_output else out[0]
+
+            sharded_fn = jax.jit(jax.shard_map(
+                _sharded_dynamic, mesh=mesh,
+                in_specs=(P(), P(mesh_axis, None)),
+                out_specs=P(), check_vma=False))
+
+            def program(inputs):
+                return sharded_fn(inputs, ids_sharded)
+
+    fn = program if mesh is not None else jax.jit(program)
     dag = CompiledJaxDAG(
         fn, num_inputs, multi_output, T,
         num_waves, wave_width, payload_shape, dtype, dynamic, op_names,
+        num_shards=n_sh if mesh is not None else 1,
     )
     dag.num_compiled_tasks = C
     return dag

@@ -13,10 +13,10 @@ class DeploymentResponse:
     """Future for one routed request; passing it as an argument to another
     handle call chains without blocking (resolved at dispatch)."""
 
-    def __init__(self, ref, replica_set, replica_idx, replica=None):
+    def __init__(self, ref, replica_set, replica_key, replica=None):
         self._ref = ref
         self._rs = replica_set
-        self._idx = replica_idx
+        self._key = replica_key
         # Strong ref for the life of the in-flight key: the router keys
         # counts by id(replica), so the object must not be GC'd (and its id
         # recycled) while this response is pending.
@@ -34,7 +34,7 @@ class DeploymentResponse:
         with self._lock:
             if not self._released:
                 self._released = True
-                self._rs.release(self._idx)
+                self._rs.release(self._key)
                 self._replica = None
 
     def _to_object_ref(self):
@@ -62,7 +62,7 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         rs = self._controller._replica_set(self._name)
-        idx, replica = rs.choose()
+        key, replica = rs.choose()
         # Chain: unwrap DeploymentResponses into ObjectRefs so downstream
         # deployments receive resolved values without blocking here.
         args = tuple(
@@ -75,7 +75,7 @@ class DeploymentHandle:
         }
         method = getattr(replica, "handle_request")
         ref = method.remote(self._method, args, kwargs)
-        resp = DeploymentResponse(ref, rs, idx, replica=replica)
+        resp = DeploymentResponse(ref, rs, key, replica=replica)
         self._controller._record_request(self._name)
         return resp
 
