@@ -103,6 +103,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rtn_tq_num_done.argtypes = [p]
     lib.rtn_tq_num_tasks.restype = u32
     lib.rtn_tq_num_tasks.argtypes = [p]
+
+    lib.rtn_dq_create.restype = p
+    lib.rtn_dq_create.argtypes = [u32, u32]
+    lib.rtn_dq_destroy.argtypes = [p]
+    lib.rtn_dq_alloc.restype = u64
+    lib.rtn_dq_alloc.argtypes = [p]
+    lib.rtn_dq_add_dep.restype = i32
+    lib.rtn_dq_add_dep.argtypes = [p, u64, u64]
+    lib.rtn_dq_commit.restype = i32
+    lib.rtn_dq_commit.argtypes = [p, u64]
+    lib.rtn_dq_complete.restype = i32
+    lib.rtn_dq_complete.argtypes = [p, u64]
+    lib.rtn_dq_pop.restype = i32
+    lib.rtn_dq_pop.argtypes = [p, ctypes.POINTER(u64), u32, i64]
+    lib.rtn_dq_wake.argtypes = [p]
+    lib.rtn_dq_num_pending.restype = u64
+    lib.rtn_dq_num_pending.argtypes = [p]
+    lib.rtn_dq_num_done.restype = u64
+    lib.rtn_dq_num_done.argtypes = [p]
     return lib
 
 

@@ -231,3 +231,64 @@ class NativeTaskQueue:
                 self._q = None
         except Exception:  # noqa: BLE001
             pass
+
+
+class NativeDynQueue:
+    """Incremental dependency queue (the live scheduler's native hot loop).
+
+    Handles are opaque uint64s with an embedded generation so completed
+    slots recycle safely; ``add_dep`` against an already-completed producer
+    is a no-op (the dependency is satisfied).
+    """
+
+    def __init__(self, max_tasks: int = 1 << 16, max_edges: int = 1 << 18):
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._q = self._lib.rtn_dq_create(max_tasks, max_edges)
+
+    def alloc(self) -> int:
+        h = self._lib.rtn_dq_alloc(self._q)
+        if h == 0:
+            raise MemoryError("dynamic task queue is full")
+        return h
+
+    def add_dep(self, task: int, dep: int):
+        rc = self._lib.rtn_dq_add_dep(self._q, task, dep)
+        if rc == -3:
+            raise MemoryError("dynamic task queue edge table is full")
+        if rc != 0:
+            raise ValueError(f"bad task handle {task:#x}")
+
+    def commit(self, task: int):
+        if self._lib.rtn_dq_commit(self._q, task) != 0:
+            raise ValueError(f"bad task handle {task:#x}")
+
+    def complete(self, task: int):
+        if self._lib.rtn_dq_complete(self._q, task) != 0:
+            raise ValueError(f"bad/uncommitted task handle {task:#x}")
+
+    def pop(self, max_tasks: int = 1024, timeout_s: float = 0.2) -> List[int]:
+        out = (ctypes.c_uint64 * max_tasks)()
+        n = self._lib.rtn_dq_pop(self._q, out, max_tasks,
+                                 int(timeout_s * 1000))
+        return list(out[:n])
+
+    def wake(self):
+        self._lib.rtn_dq_wake(self._q)
+
+    @property
+    def num_pending(self) -> int:
+        return self._lib.rtn_dq_num_pending(self._q)
+
+    @property
+    def num_done(self) -> int:
+        return self._lib.rtn_dq_num_done(self._q)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_q", None):
+                self._lib.rtn_dq_destroy(self._q)
+                self._q = None
+        except Exception:  # noqa: BLE001
+            pass

@@ -137,3 +137,11 @@ _D("shm_store_bytes", int, 128 * 1024 * 1024,
    "Shared-memory store segment size for the native object store.")
 _D("shm_store_slots", int, 4096,
    "Max concurrent objects in the native shared-memory store.")
+_D("use_native_queue", bool, True,
+   "Route task dependency tracking through the C++ ready-ring when the "
+   "native layer is available.")
+_D("worker_mode", str, "thread",
+   "Task execution plane: 'thread' (in-process pool) or 'process' "
+   "(spawned worker processes over the shm store).")
+_D("worker_channel_bytes", int, 4 * 1024 * 1024,
+   "Request/reply channel buffer size per worker process.")

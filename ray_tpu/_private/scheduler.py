@@ -98,7 +98,9 @@ class LocalScheduler:
 
     def __init__(self, store, resource_pool: ResourcePool, num_workers: int,
                  task_events: Optional[TaskEventBuffer] = None,
-                 lineage: Optional[dict] = None):
+                 lineage: Optional[dict] = None,
+                 worker_pool=None, shm_store=None,
+                 use_native_queue: Optional[bool] = None):
         self._store = store
         self._resources = resource_pool
         self._pool = ThreadPoolExecutor(
@@ -115,6 +117,31 @@ class LocalScheduler:
         self._backlog = 0
         self._num_finished = 0
         self._dispatch_cv = threading.Condition(self._lock)
+        # Process execution plane (WorkerPool + shm object store); tasks run
+        # in worker processes when present, in the thread pool otherwise.
+        self._worker_pool = worker_pool
+        self._shm_store = shm_store
+        self._proc_running: Dict[TaskID, Any] = {}  # task -> WorkerProcess
+        # Native dependency queue: the C++ ready-ring replaces the python
+        # callback chain for deps between normal tasks.
+        self._dq = None
+        self._dq_handles: Dict[TaskID, int] = {}   # pending task -> handle
+        self._dq_specs: Dict[int, TaskSpec] = {}
+        if use_native_queue is None:
+            use_native_queue = GlobalConfig.use_native_queue
+        if use_native_queue:
+            try:
+                from ray_tpu._native.store import NativeDynQueue
+
+                self._dq = NativeDynQueue()
+            except Exception:  # noqa: BLE001 — native layer optional
+                self._dq = None
+        if self._dq is not None:
+            self._dq_pump = threading.Thread(
+                target=self._dq_pump_loop, daemon=True,
+                name="ray_tpu_dq_pump",
+            )
+            self._dq_pump.start()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="ray_tpu_dispatcher",
@@ -129,6 +156,11 @@ class LocalScheduler:
                                 name=spec.name)
         self._lineage[spec.return_ids[0].task_id()] = spec
         dep_refs = _collect_refs(spec.args, spec.kwargs)
+        if self._dq is not None:
+            try:
+                return self._submit_native(spec, dep_refs)
+            except MemoryError:
+                pass  # queue full: fall through to the python path
         with self._lock:
             self._backlog += 1
             if not dep_refs:
@@ -150,6 +182,75 @@ class LocalScheduler:
 
         for ref in dep_refs:
             self._store.on_ready(ref.object_id, _on_dep_ready)
+
+    def _submit_native(self, spec: TaskSpec, dep_refs: list):
+        """Dependency tracking through the C++ ready-ring: deps between
+        pending normal tasks become native edges; anything else (puts,
+        actor outputs, recovering objects) gates the commit via the store
+        callback."""
+        dq = self._dq
+        handle = dq.alloc()  # MemoryError -> caller falls back
+        fallback_refs = []
+        with self._lock:
+            self._backlog += 1
+            self._dq_handles[spec.task_id] = handle
+            self._dq_specs[handle] = spec
+            for ref in dep_refs:
+                producer = self._dq_handles.get(ref.object_id.task_id())
+                if self._store.contains(ref.object_id):
+                    continue
+                if producer is not None and producer != handle:
+                    dq.add_dep(handle, producer)
+                else:
+                    fallback_refs.append(ref)
+            if not fallback_refs:
+                dq.commit(handle)
+                return
+            self._pending_deps[spec.task_id] = len(fallback_refs)
+
+        def _on_dep_ready():
+            with self._lock:
+                remaining = self._pending_deps.get(spec.task_id)
+                if remaining is None:
+                    return
+                remaining -= 1
+                if remaining == 0:
+                    del self._pending_deps[spec.task_id]
+                else:
+                    self._pending_deps[spec.task_id] = remaining
+                    return
+            dq.commit(handle)
+
+        for ref in fallback_refs:
+            self._store.on_ready(ref.object_id, _on_dep_ready)
+
+    def _dq_pump_loop(self):
+        """Drain the native ready-ring into the dispatch queue."""
+        while True:
+            with self._lock:
+                if self._shutdown:
+                    return
+            handles = self._dq.pop(1024, timeout_s=0.2)
+            if not handles:
+                continue
+            with self._lock:
+                for h in handles:
+                    spec = self._dq_specs.pop(h, None)
+                    if spec is not None:
+                        self._make_runnable_locked(spec)
+
+    def _finalize_native(self, spec: TaskSpec):
+        """Outputs are final: release the native slot, readying consumers."""
+        if self._dq is None:
+            return
+        with self._lock:
+            handle = self._dq_handles.pop(spec.task_id, None)
+            self._dq_specs.pop(handle, None)
+        if handle is not None:
+            try:
+                self._dq.complete(handle)
+            except ValueError:
+                pass
 
     def _make_runnable_locked(self, spec: TaskSpec):
         self._runnable.append(spec)
@@ -195,20 +296,26 @@ class LocalScheduler:
         retry_spec = None
         try:
             args, kwargs = _resolve_args(self._store, spec.args, spec.kwargs)
-            worker_mod._task_context.current_task_id = spec.task_id
-            worker_mod._task_context.task_name = spec.name
-            try:
-                result = spec.function(*args, **kwargs)
-            finally:
-                worker_mod._task_context.current_task_id = None
-                worker_mod._task_context.task_name = None
-            self._store_outputs(spec, result)
+            if self._worker_pool is not None:
+                self._execute_in_process(spec, args, kwargs, cancelled_event)
+            else:
+                worker_mod._task_context.current_task_id = spec.task_id
+                worker_mod._task_context.task_name = spec.name
+                try:
+                    result = spec.function(*args, **kwargs)
+                finally:
+                    worker_mod._task_context.current_task_id = None
+                    worker_mod._task_context.task_name = None
+                self._store_outputs(spec, result)
             if self._events:
                 self._events.record(
                     spec.task_id, "FINISHED", name=spec.name,
                     duration=time.monotonic() - start)
+            self._finalize_native(spec)
         except Exception as exc:  # noqa: BLE001 — task error boundary
             retry_spec = self._handle_failure(spec, exc)
+            if retry_spec is None:
+                self._finalize_native(spec)  # error outputs are final
         finally:
             with self._lock:
                 self._running.pop(spec.task_id, None)
@@ -221,6 +328,47 @@ class LocalScheduler:
                 with self._lock:
                     self._backlog += 1
                     self._make_runnable_locked(retry_spec)
+
+    def _execute_in_process(self, spec: TaskSpec, args, kwargs,
+                            cancelled_event):
+        """Ship the task to a leased worker process; outputs come back
+        through the shm store (WorkerPool plane)."""
+        from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu._private.worker_pool import (
+            oid_key,
+            pack_args,
+            pack_function,
+        )
+
+        ctx = global_worker().serialization_context
+        w = self._worker_pool.lease()
+        staged: list = []
+        try:
+            digest, fn_bytes = pack_function(spec.function)
+            payload, staged = pack_args(self._shm_store, ctx, args, kwargs)
+            ret_keys = [oid_key(oid) for oid in spec.return_ids]
+            with self._lock:
+                self._proc_running[spec.task_id] = w
+            try:
+                w.request(
+                    ("task", digest, fn_bytes, payload, ret_keys,
+                     spec.num_returns),
+                    cancel_event=cancelled_event)
+            finally:
+                with self._lock:
+                    self._proc_running.pop(spec.task_id, None)
+            for oid, key in zip(spec.return_ids, ret_keys):
+                raw = bytes(self._shm_store.get(key))
+                self._store.put(oid, SerializedObject.from_bytes(raw))
+                self._shm_store.delete(key)
+        finally:
+            for key in staged:
+                try:
+                    self._shm_store.delete(key)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            self._worker_pool.release(w)
 
     def _store_outputs(self, spec: TaskSpec, result: Any):
         from ray_tpu._private.worker import global_worker
@@ -239,7 +387,12 @@ class LocalScheduler:
             self._store.put(oid, ctx.serialize(value))
 
     def _handle_failure(self, spec: TaskSpec, exc: Exception):
-        is_app_error = not isinstance(exc, (SystemError, MemoryError))
+        # Worker-process death is a system failure: retriable by default,
+        # like the reference's WorkerCrashedError semantics.
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        is_app_error = not isinstance(
+            exc, (SystemError, MemoryError, WorkerCrashedError))
         retriable = spec.attempt < spec.max_retries and (
             spec.retry_exceptions or not is_app_error
         )
@@ -267,11 +420,12 @@ class LocalScheduler:
         err = TaskCancelledError(spec.task_id)
         for oid in spec.return_ids:
             self._store.put_error(oid, err)
+        self._finalize_native(spec)
         with self._lock:
             self._backlog -= 1
 
     # ----------------------------------------------------------- cancel/misc
-    def cancel(self, task_id: TaskID):
+    def cancel(self, task_id: TaskID, force: bool = False):
         with self._lock:
             self._cancelled.add(task_id)
             for i, spec in enumerate(self._runnable):
@@ -283,8 +437,16 @@ class LocalScheduler:
                     ).start()
                     return True
             ev = self._running.get(task_id)
+            proc = self._proc_running.get(task_id)
             if ev is not None:
                 ev.set()  # cooperative: running tasks can poll was_cancelled
+                if force and proc is not None:
+                    # Process plane: force-cancel actually stops the task by
+                    # killing its worker (the pool replaces it); the waiting
+                    # executor observes the cancel event and reports
+                    # TaskCancelledError rather than a crash.
+                    proc.kill()
+                    return True
                 return False
         # Not queued and not running: either not yet dep-resolved or done.
         return False

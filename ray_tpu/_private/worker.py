@@ -127,7 +127,8 @@ class Worker:
     def __init__(self, num_cpus: Optional[int] = None,
                  num_tpus: Optional[int] = None,
                  resources: Optional[Dict[str, float]] = None,
-                 session_dir: Optional[str] = None):
+                 session_dir: Optional[str] = None,
+                 worker_mode: Optional[str] = None):
         self.is_alive = True
         self.job_id = JobID.from_int(os.getpid() & 0xFFFFFFFF)
         self.worker_id = WorkerID.from_random()
@@ -159,9 +160,25 @@ class Worker:
         total.update(resources or {})
         self.resource_pool = ResourcePool(total)
         pool_size = GlobalConfig.worker_pool_size or max(int(num_cpus), 4)
+        # Process execution plane: worker processes leased from a pool, fed
+        # over the native shm store (reference: raylet WorkerPool + plasma).
+        self.worker_mode = worker_mode or GlobalConfig.worker_mode
+        self.shm_store = None
+        self.worker_pool = None
+        if self.worker_mode == "process":
+            from ray_tpu._native.store import NativeObjectStore
+            from ray_tpu._private.worker_pool import WorkerPool
+
+            self.shm_store = NativeObjectStore.create(
+                capacity=GlobalConfig.shm_store_bytes,
+                max_objects=GlobalConfig.shm_store_slots)
+            self.worker_pool = WorkerPool(
+                self.shm_store, num_workers=max(int(num_cpus), 1),
+                max_msg=GlobalConfig.worker_channel_bytes)
         self.scheduler = LocalScheduler(
             self.store, self.resource_pool, pool_size,
             task_events=self.task_events,
+            worker_pool=self.worker_pool, shm_store=self.shm_store,
         )
         self.submission_counter = _Counter()
         self.put_counter = _Counter()
@@ -261,6 +278,12 @@ class Worker:
         self.actors.clear()
         self.named_actors.clear()
         self.scheduler.shutdown()
+        if self.worker_pool is not None:
+            self.worker_pool.shutdown()
+            self.worker_pool = None
+        if self.shm_store is not None:
+            self.shm_store.close()
+            self.shm_store = None
 
 
 _global_worker: Optional[Worker] = None
@@ -288,6 +311,7 @@ def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          resources: Optional[Dict[str, float]] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False, namespace: str = "default",
+         worker_mode: Optional[str] = None,
          **_ignored) -> "Worker":
     global _global_worker
     with _init_lock:
@@ -300,7 +324,8 @@ def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
         if _system_config:
             GlobalConfig.apply_system_config(_system_config)
         _global_worker = Worker(num_cpus=num_cpus, num_tpus=num_tpus,
-                                resources=resources)
+                                resources=resources,
+                                worker_mode=worker_mode)
         _global_worker.namespace = namespace
         atexit.register(shutdown)
         return _global_worker
@@ -368,6 +393,6 @@ def wait(refs: List[ObjectRef], *, num_returns: int = 1,
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     worker = global_worker()
     task_id = ref.object_id.task_id()
-    removed = worker.scheduler.cancel(task_id)
+    removed = worker.scheduler.cancel(task_id, force=force)
     if removed or force:
         worker.store.cancel(ref.object_id, task_id)

@@ -1,0 +1,161 @@
+"""Worker process entrypoint: the leased-worker execution loop.
+
+Rebuild of the reference's worker process main (reference role:
+python/ray/_private/workers/default_worker.py + the CoreWorker task
+execution loop it enters [unverified]). The driver's WorkerPool spawns this
+module as a subprocess per worker; requests arrive over a shared-memory
+mutable-object channel (the plasma-mutable-object analogue), argument and
+result payloads ride the shared-memory object store, and replies go back on
+a second channel. A ``kill -9`` of this process is detected by the driver
+through process liveness + reply timeout and surfaces as
+``WorkerCrashedError`` — never as a driver crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import traceback
+from typing import Any, Dict, List, Optional
+
+
+class _ShmRef:
+    """Marker for an argument stored in the shm object store."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: int):
+        self.key = key
+
+
+def _load_payload(store, ctx, payload: bytes):
+    """Deserialize (args, kwargs), fetching _ShmRef args from the store."""
+    from ray_tpu._private.serialization import SerializedObject
+
+    args, kwargs = pickle.loads(payload)
+
+    def _fetch(v):
+        if isinstance(v, _ShmRef):
+            raw = bytes(store.get(v.key))
+            return ctx.deserialize(SerializedObject.from_bytes(raw))
+        return v
+
+    return (tuple(_fetch(a) for a in args),
+            {k: _fetch(v) for k, v in kwargs.items()})
+
+
+def _store_outputs(store, ctx, return_keys: List[int], result: Any,
+                   num_returns: int):
+    if num_returns <= 1:
+        outputs = [result]
+    else:
+        outputs = list(result)
+        if len(outputs) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(outputs)} values")
+    for key, value in zip(return_keys, outputs):
+        store.put(key, ctx.serialize(value).to_bytes())
+
+
+def worker_loop(store_name: str, req_id: int, rep_id: int,
+                worker_id: int, max_msg: int) -> None:
+    # Workers never touch the TPU: the device belongs to the driver (the
+    # compiled-graph path); keep jax (if imported by user code) on CPU.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import cloudpickle
+
+    from ray_tpu._native.store import NativeMutableChannel, NativeObjectStore
+    from ray_tpu._private.serialization import SerializationContext
+    from ray_tpu.exceptions import ChannelError, ChannelTimeoutError, \
+        RayTaskError
+
+    store = NativeObjectStore.open(store_name)
+    req = NativeMutableChannel(store, req_id, max_size=max_msg,
+                               num_readers=1, create=False)
+    rep = NativeMutableChannel(store, rep_id, max_size=max_msg,
+                               num_readers=1, create=False)
+
+    ctx = SerializationContext()
+    fn_cache: Dict[bytes, Any] = {}
+    actor_instance: Optional[Any] = None
+
+    while True:
+        try:
+            msg = req.read(timeout=5.0)
+        except ChannelTimeoutError:
+            # Liveness escape hatch: if the parent died, exit.
+            if os.getppid() == 1:
+                return
+            continue
+        except ChannelError:
+            return
+
+        kind = msg[0]
+        try:
+            if kind == "exit":
+                rep.write(("ok", None))
+                return
+            elif kind == "ping":
+                rep.write(("ok", os.getpid()))
+            elif kind == "task":
+                _, digest, fn_bytes, payload, return_keys, num_returns = msg
+                fn = fn_cache.get(digest)
+                if fn is None:
+                    fn = cloudpickle.loads(fn_bytes)
+                    fn_cache[digest] = fn
+                args, kwargs = _load_payload(store, ctx, payload)
+                result = fn(*args, **kwargs)
+                _store_outputs(store, ctx, return_keys, result, num_returns)
+                rep.write(("ok", None))
+            elif kind == "actor_new":
+                _, cls_bytes, payload = msg
+                cls = cloudpickle.loads(cls_bytes)
+                args, kwargs = _load_payload(store, ctx, payload)
+                actor_instance = cls(*args, **kwargs)
+                rep.write(("ok", None))
+            elif kind == "actor_call":
+                _, method_name, payload, return_keys, num_returns = msg
+                if actor_instance is None:
+                    raise RuntimeError("actor_call before actor_new")
+                method = getattr(actor_instance, method_name)
+                args, kwargs = _load_payload(store, ctx, payload)
+                result = method(*args, **kwargs)
+                _store_outputs(store, ctx, return_keys, result, num_returns)
+                rep.write(("ok", None))
+            else:
+                raise ValueError(f"unknown request kind {kind!r}")
+        except BaseException as exc:  # noqa: BLE001 — worker error boundary
+            name = msg[1] if kind == "actor_call" else "task"
+            try:
+                err = RayTaskError.from_exception(str(name), exc)
+                rep.write(("err", pickle.dumps(err)))
+            except Exception:  # noqa: BLE001 — unpicklable cause fallback
+                err = RayTaskError(str(name), traceback.format_exc(),
+                                   cause=None)
+                rep.write(("err", pickle.dumps(err)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--req-id", type=int, required=True)
+    ap.add_argument("--rep-id", type=int, required=True)
+    ap.add_argument("--worker-id", type=int, default=0)
+    ap.add_argument("--max-msg", type=int, default=4 << 20)
+    args = ap.parse_args(argv)
+    worker_loop(args.store, args.req_id, args.rep_id, args.worker_id,
+                args.max_msg)
+    return 0
+
+
+if __name__ == "__main__":
+    # Re-dispatch through the canonical import so _ShmRef has one class
+    # identity (running under -m makes this module __main__, which would
+    # otherwise break isinstance against driver-pickled markers).
+    from ray_tpu._private import worker_main as _canonical
+
+    sys.exit(_canonical.main())

@@ -40,6 +40,22 @@ class RayTaskError(RayTpuError):
         )
         return cls(function_name, tb, cause=exc)
 
+    def __reduce__(self):
+        # Cross-process transport: keep the cause when it pickles (typed
+        # re-raise via as_instanceof_cause), drop it otherwise — default
+        # Exception reduction would call __init__ with the formatted
+        # message only and fail.
+        import pickle as _pickle
+
+        cause = self.cause
+        if cause is not None:
+            try:
+                _pickle.dumps(cause)
+            except Exception:  # noqa: BLE001 — unpicklable cause
+                cause = None
+        return (RayTaskError,
+                (self.function_name, self.traceback_str, cause))
+
     def as_instanceof_cause(self) -> BaseException:
         """Return an exception that is `isinstance` of the original type."""
         if self.cause is None:
