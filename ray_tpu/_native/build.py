@@ -115,6 +115,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rtn_dq_commit.argtypes = [p, u64]
     lib.rtn_dq_complete.restype = i32
     lib.rtn_dq_complete.argtypes = [p, u64]
+    lib.rtn_dq_abort.restype = i32
+    lib.rtn_dq_abort.argtypes = [p, u64]
     lib.rtn_dq_pop.restype = i32
     lib.rtn_dq_pop.argtypes = [p, ctypes.POINTER(u64), u32, i64]
     lib.rtn_dq_wake.argtypes = [p]

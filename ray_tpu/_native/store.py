@@ -268,6 +268,12 @@ class NativeDynQueue:
         if self._lib.rtn_dq_complete(self._q, task) != 0:
             raise ValueError(f"bad/uncommitted task handle {task:#x}")
 
+    def abort(self, task: int):
+        """Abandon a task that never ran (registration unwind); its slot is
+        recycled and edges into it go stale via the generation tag."""
+        if self._lib.rtn_dq_abort(self._q, task) != 0:
+            raise ValueError(f"bad task handle {task:#x}")
+
     def pop(self, max_tasks: int = 1024, timeout_s: float = 0.2) -> List[int]:
         out = (ctypes.c_uint64 * max_tasks)()
         n = self._lib.rtn_dq_pop(self._q, out, max_tasks,

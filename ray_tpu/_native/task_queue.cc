@@ -211,6 +211,9 @@ struct DynQueue {
   uint32_t* head;       // per slot: first outgoing edge (consumers)
   uint32_t* enext;      // per edge
   uint32_t* edst;       // per edge: consumer slot
+  uint32_t* egen;       // per edge: consumer generation at add time (edges
+                        // into an aborted slot go stale instead of
+                        // corrupting whatever recycled the slot)
   uint32_t* edge_free;  // stack
   uint32_t edge_free_top;
   uint32_t* slot_free;  // stack
@@ -249,6 +252,7 @@ void* rtn_dq_create(uint32_t cap, uint32_t edge_cap) {
   q->head = new uint32_t[cap];
   q->enext = new uint32_t[edge_cap];
   q->edst = new uint32_t[edge_cap];
+  q->egen = new uint32_t[edge_cap];
   q->edge_free = new uint32_t[edge_cap];
   for (uint32_t i = 0; i < edge_cap; i++) q->edge_free[i] = edge_cap - 1 - i;
   q->edge_free_top = edge_cap;
@@ -270,6 +274,7 @@ void rtn_dq_destroy(void* handle) {
   delete[] q->head;
   delete[] q->enext;
   delete[] q->edst;
+  delete[] q->egen;
   delete[] q->edge_free;
   delete[] q->slot_free;
   delete[] q->ring;
@@ -320,6 +325,7 @@ int rtn_dq_add_dep(void* handle, uint64_t task, uint64_t dep) {
   }
   uint32_t e = q->edge_free[--q->edge_free_top];
   q->edst[e] = t;
+  q->egen[e] = q->gen[t];
   q->enext[e] = q->head[d];
   q->head[d] = e;
   q->indeg[t]++;
@@ -359,7 +365,8 @@ int rtn_dq_complete(void* handle, uint64_t task) {
   int woke = 0;
   while (e != kNil) {
     uint32_t c = q->edst[e];
-    if (--q->indeg[c] == 0 && q->state[c] == 2) {
+    if (q->gen[c] == q->egen[e] &&
+        --q->indeg[c] == 0 && q->state[c] == 2) {
       q->ring[q->rtail] = dq_handle(q, c);
       if (++q->rtail == q->ring_cap) q->rtail = 0;
       woke = 1;
@@ -373,6 +380,42 @@ int rtn_dq_complete(void* handle, uint64_t task) {
   q->slot_free[q->slot_free_top++] = t;
   q->num_pending--;
   q->num_done++;
+  if (woke) pthread_cond_broadcast(&q->cv);
+  pthread_mutex_unlock(&q->mu);
+  return 0;
+}
+
+// Abandon an allocated-or-committed task that never ran (e.g. the caller
+// hit the edge-table-full MemoryError mid-registration and is unwinding).
+// Consumers' edges are released as satisfied; edges INTO this slot from
+// still-pending producers go stale via the generation tag and are freed
+// when those producers complete. Not counted in num_done.
+int rtn_dq_abort(void* handle, uint64_t task) {
+  DynQueue* q = (DynQueue*)handle;
+  pthread_mutex_lock(&q->mu);
+  uint32_t t = dq_slot(q, task);
+  if (t == kNil) {
+    pthread_mutex_unlock(&q->mu);
+    return -1;
+  }
+  uint32_t e = q->head[t];
+  int woke = 0;
+  while (e != kNil) {
+    uint32_t c = q->edst[e];
+    if (q->gen[c] == q->egen[e] &&
+        --q->indeg[c] == 0 && q->state[c] == 2) {
+      q->ring[q->rtail] = dq_handle(q, c);
+      if (++q->rtail == q->ring_cap) q->rtail = 0;
+      woke = 1;
+    }
+    uint32_t nxt = q->enext[e];
+    q->edge_free[q->edge_free_top++] = e;
+    e = nxt;
+  }
+  q->state[t] = 0;
+  q->gen[t]++;
+  q->slot_free[q->slot_free_top++] = t;
+  q->num_pending--;
   if (woke) pthread_cond_broadcast(&q->cv);
   pthread_mutex_unlock(&q->mu);
   return 0;

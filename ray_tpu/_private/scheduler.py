@@ -18,6 +18,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.task_events import TaskEventBuffer
 from ray_tpu.exceptions import (
@@ -191,22 +192,38 @@ class LocalScheduler:
         dq = self._dq
         handle = dq.alloc()  # MemoryError -> caller falls back
         fallback_refs = []
-        with self._lock:
-            self._backlog += 1
-            self._dq_handles[spec.task_id] = handle
-            self._dq_specs[handle] = spec
-            for ref in dep_refs:
-                producer = self._dq_handles.get(ref.object_id.task_id())
-                if self._store.contains(ref.object_id):
-                    continue
-                if producer is not None and producer != handle:
-                    dq.add_dep(handle, producer)
-                else:
-                    fallback_refs.append(ref)
-            if not fallback_refs:
-                dq.commit(handle)
-                return
-            self._pending_deps[spec.task_id] = len(fallback_refs)
+        try:
+            with self._lock:
+                self._backlog += 1
+                self._dq_handles[spec.task_id] = handle
+                self._dq_specs[handle] = spec
+                try:
+                    for ref in dep_refs:
+                        producer = self._dq_handles.get(
+                            ref.object_id.task_id())
+                        if self._store.contains(ref.object_id):
+                            continue
+                        if producer is not None and producer != handle:
+                            dq.add_dep(handle, producer)
+                        else:
+                            fallback_refs.append(ref)
+                except MemoryError:
+                    # Edge table full mid-registration: unwind everything
+                    # this call registered so the caller's python-path
+                    # fallback starts from a clean slate (no double-counted
+                    # backlog, no stale never-completed handle for
+                    # consumers to dep on).
+                    del self._dq_handles[spec.task_id]
+                    del self._dq_specs[handle]
+                    self._backlog -= 1
+                    raise
+                if not fallback_refs:
+                    dq.commit(handle)
+                    return
+                self._pending_deps[spec.task_id] = len(fallback_refs)
+        except MemoryError:
+            dq.abort(handle)  # recycle the slot; edges into it go stale
+            raise
 
         def _on_dep_ready():
             with self._lock:
@@ -344,10 +361,14 @@ class LocalScheduler:
         ctx = global_worker().serialization_context
         w = self._worker_pool.lease()
         staged: list = []
+        ret_keys = [oid_key(oid) for oid in spec.return_ids]
         try:
             digest, fn_bytes = pack_function(spec.function)
             payload, staged = pack_args(self._shm_store, ctx, args, kwargs)
-            ret_keys = [oid_key(oid) for oid in spec.return_ids]
+            # A prior attempt may have died AFTER storing outputs but
+            # BEFORE replying; clear any stale ret keys so the worker's
+            # put can't fail with "exists" on the retry.
+            self._delete_shm_keys(ret_keys)
             with self._lock:
                 self._proc_running[spec.task_id] = w
             try:
@@ -362,13 +383,21 @@ class LocalScheduler:
                 raw = bytes(self._shm_store.get(key))
                 self._store.put(oid, SerializedObject.from_bytes(raw))
                 self._shm_store.delete(key)
+        except BaseException:
+            # Failure path: a crashed worker may have left some ret keys
+            # behind — reclaim the shm slots.
+            self._delete_shm_keys(ret_keys)
+            raise
         finally:
-            for key in staged:
-                try:
-                    self._shm_store.delete(key)
-                except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
+            self._delete_shm_keys(staged)
             self._worker_pool.release(w)
+
+    def _delete_shm_keys(self, keys):
+        for key in keys:
+            try:
+                self._shm_store.delete(key)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
 
     def _store_outputs(self, spec: TaskSpec, result: Any):
         from ray_tpu._private.worker import global_worker
@@ -389,10 +418,14 @@ class LocalScheduler:
     def _handle_failure(self, spec: TaskSpec, exc: Exception):
         # Worker-process death is a system failure: retriable by default,
         # like the reference's WorkerCrashedError semantics.
-        from ray_tpu.exceptions import WorkerCrashedError
+        from ray_tpu.exceptions import (
+            WorkerCrashedError,
+            WorkerPoolExhaustedError,
+        )
 
         is_app_error = not isinstance(
-            exc, (SystemError, MemoryError, WorkerCrashedError))
+            exc, (SystemError, MemoryError, WorkerCrashedError,
+                  WorkerPoolExhaustedError))
         retriable = spec.attempt < spec.max_retries and (
             spec.retry_exceptions or not is_app_error
         )
@@ -467,6 +500,11 @@ class LocalScheduler:
             self._shutdown = True
             self._dispatch_cv.notify_all()
         self._dispatcher.join(timeout=2)
+        if self._dq is not None:
+            # Wake + join the pump so it can't be blocked inside rtn_dq_pop
+            # when the queue's destructor frees the native state.
+            self._dq.wake()
+            self._dq_pump.join(timeout=2)
         self._pool.shutdown(wait=False, cancel_futures=True)
 
 

@@ -19,6 +19,7 @@ import queue
 import subprocess
 import sys
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.worker_main import _ShmRef
@@ -27,6 +28,7 @@ from ray_tpu.exceptions import (
     ChannelTimeoutError,
     TaskCancelledError,
     WorkerCrashedError,
+    WorkerPoolExhaustedError,
 )
 
 _INLINE_LIMIT = 512 * 1024  # args bigger than this ride the shm store
@@ -148,7 +150,13 @@ class WorkerPool:
 
     def lease(self, timeout: float = 60.0) -> WorkerProcess:
         while True:
-            w = self._idle.get(timeout=timeout)
+            try:
+                w = self._idle.get(timeout=timeout)
+            except queue.Empty:
+                raise WorkerPoolExhaustedError(
+                    f"no idle worker within {timeout:.0f}s "
+                    f"(pool size {self.size}); long-running tasks may be "
+                    f"holding every worker") from None
             if w.alive():
                 return w
             # Crashed while idle: replace and retry.
@@ -197,7 +205,10 @@ class WorkerPool:
 # Task payload packing (driver side)
 # ---------------------------------------------------------------------------
 
-_fn_digest_cache: Dict[int, Tuple[bytes, bytes]] = {}
+# Keyed by the function OBJECT (weakly): an id()-keyed cache would serve a
+# stale entry when CPython recycles the id of a collected function.
+_fn_digest_cache: "weakref.WeakKeyDictionary[Any, Tuple[bytes, bytes]]" = (
+    weakref.WeakKeyDictionary())
 _fn_cache_lock = threading.Lock()
 
 
@@ -206,14 +217,22 @@ def pack_function(fn) -> Tuple[bytes, bytes]:
     digest so the bytes only cross once per (worker, function)."""
     import cloudpickle
 
-    with _fn_cache_lock:
-        hit = _fn_digest_cache.get(id(fn))
+    try:
+        with _fn_cache_lock:
+            hit = _fn_digest_cache.get(fn)
         if hit is not None:
             return hit
+        cacheable = True
+    except TypeError:  # unhashable callable
+        cacheable = False
     data = cloudpickle.dumps(fn)
     digest = hashlib.sha1(data).digest()
-    with _fn_cache_lock:
-        _fn_digest_cache[id(fn)] = (digest, data)
+    if cacheable:
+        try:
+            with _fn_cache_lock:
+                _fn_digest_cache[fn] = (digest, data)
+        except TypeError:  # not weakref-able: skip caching
+            pass
     return digest, data
 
 

@@ -122,6 +122,11 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class WorkerPoolExhaustedError(RayTpuError):
+    """No worker process became idle within the lease deadline. System
+    condition (pool pressure), not a task failure — treated as retriable."""
+
+
 class OutOfMemoryError(RayTpuError):
     pass
 
