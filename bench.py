@@ -46,7 +46,19 @@ def _time_pipelined(compiled, n_iters, *args):
     return (time.perf_counter() - t0) / n_iters
 
 
-def bench_chain(n_tasks=1000, n_iters=10):
+def _median_iqr(vals):
+    """(median, iqr) — the chip swings ±30% run-to-run, so single numbers
+    are noise; the driver artifact carries the spread."""
+    med = statistics.median(vals)
+    if len(vals) >= 4:
+        q = statistics.quantiles(vals, n=4)
+        iqr = q[2] - q[0]
+    else:
+        iqr = max(vals) - min(vals)
+    return med, iqr
+
+
+def bench_chain(n_tasks=1000, n_iters=10, repeats=5):
     """Config #1: single-node no-op task chain."""
     from ray_tpu.dag import InputNode
     import ray_tpu
@@ -61,17 +73,30 @@ def bench_chain(n_tasks=1000, n_iters=10):
             node = noop.bind(node)
     compiled = node.experimental_compile(backend="jax")
     compiled.execute(0.0).get()  # warmup/compile
-    amortized = _time_pipelined(compiled, n_iters, 0.0)
+    per_repeat = [_time_pipelined(compiled, n_iters, 0.0)
+                  for _ in range(repeats)]
+    rates = [n_tasks / t for t in per_repeat]
+    rate_med, rate_iqr = _median_iqr(rates)
+    amortized = statistics.median(per_repeat)
+    # Measured synchronous end-to-end latency (execute + blocking get):
+    # includes the host<->device round trip, unlike the amortized number.
+    sync = _time_executions(compiled, max(2 * repeats, 10), 0.0)
+    sync.sort()
     return {
         "suite": "chain_1k_noop",
-        "tasks_per_sec": n_tasks / amortized,
+        "tasks_per_sec": rate_med,
+        "tasks_per_sec_iqr": rate_iqr,
+        "repeats": repeats,
         "task_latency_us": amortized / n_tasks * 1e6,
+        "sync_exec_p50_us": sync[len(sync) // 2] * 1e6,
+        "sync_exec_p99_us": sync[min(len(sync) - 1,
+                                     int(len(sync) * 0.99))] * 1e6,
         "wall_s_per_exec": amortized,
         "num_tasks": n_tasks,
     }
 
 
-def bench_fanout(width=10_000, n_iters=10):
+def bench_fanout(width=10_000, n_iters=10, repeats=5):
     """Config #2: wide fan-out -> fan-in reduce."""
     from ray_tpu.dag import InputNode, reduce_tree
     import ray_tpu
@@ -94,10 +119,16 @@ def bench_fanout(width=10_000, n_iters=10):
     n_total = compiled.num_tasks
     out = compiled.execute(1.0).get()  # warmup + parity check
     assert float(out) == float(width), f"fan-in parity: {out} != {width}"
-    amortized = _time_pipelined(compiled, n_iters, 1.0)
+    per_repeat = [_time_pipelined(compiled, n_iters, 1.0)
+                  for _ in range(repeats)]
+    rates = [n_total / t for t in per_repeat]
+    rate_med, rate_iqr = _median_iqr(rates)
+    amortized = statistics.median(per_repeat)
     return {
         "suite": "fanout_10k",
-        "tasks_per_sec": n_total / amortized,
+        "tasks_per_sec": rate_med,
+        "tasks_per_sec_iqr": rate_iqr,
+        "repeats": repeats,
         "task_latency_us": amortized / n_total * 1e6,
         "wall_s_per_exec": amortized,
         "num_tasks": n_total,
@@ -166,6 +197,129 @@ def bench_data_map_batches():
         return {"suite": "data_map_batches", "skipped": repr(e)}
 
 
+_PEAK_BF16_TFLOPS = {
+    # Dense bf16 peak per chip (public spec sheets).
+    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+
+
+def _chip_peak_tflops(device) -> float:
+    import os
+
+    env = os.environ.get("RAY_TPU_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "") or ""
+    for tag, peak in _PEAK_BF16_TFLOPS.items():
+        if tag in kind.lower().replace(" ", ""):
+            return peak
+    return _PEAK_BF16_TFLOPS["v5e"]  # BASELINE.md target hardware
+
+
+def bench_model_train_step(repeats=5, inner=10):
+    """Config #6: flagship transformer train step on the accelerator —
+    tokens/sec + MFU vs chip bf16 peak, plus an on-chip numerics check of
+    the Pallas kernels against the dense jax path (SURVEY.md §6)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ray_tpu.models import TransformerConfig, init_params, loss_fn
+
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        device = accel[0] if accel else jax.devices()[0]
+        on_accel = bool(accel)
+        batch, seq = 8, 1024
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq_len=seq, dtype=jnp.bfloat16)
+        with jax.default_device(device):
+            params = init_params(cfg, jax.random.PRNGKey(0))
+            opt = optax.adamw(3e-4)
+            opt_state = opt.init(params)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+            targets = jax.random.randint(
+                jax.random.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+
+            @jax.jit
+            def step(params, opt_state, tokens, targets):
+                loss, grads = jax.value_and_grad(
+                    lambda p: loss_fn(cfg, p, tokens, targets))(params)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state, loss
+
+            params, opt_state, loss = step(
+                params, opt_state, tokens, targets)  # compile + warmup
+            float(loss)  # host transfer: the only sync the tunnel can't defer
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(inner):
+                    params, opt_state, loss = step(
+                        params, opt_state, tokens, targets)
+                float(loss)
+                times.append((time.perf_counter() - t0) / inner)
+            med, iqr = _median_iqr(times)
+
+            # Pallas kernels, numerics-checked on this device (they fall
+            # back to interpret mode off-TPU; `pallas_native` records which
+            # path actually executed).
+            from ray_tpu.ops import flash_attention, rms_norm_fused
+
+            q, k, v = (jax.random.normal(
+                jax.random.PRNGKey(3 + i), (2, 4, 512, 128),
+                dtype=jnp.bfloat16) for i in range(3))
+            flash = flash_attention(q, k, v, causal=True)
+            s = jnp.einsum("bhqd,bhkd->bhqk",
+                           q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * (128 ** -0.5)
+            mask = (jnp.arange(512)[:, None] >= jnp.arange(512)[None, :])
+            s = jnp.where(mask[None, None], s, -1e30)
+            dense = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+                               v.astype(jnp.float32))
+            flash_err = float(jnp.max(jnp.abs(
+                flash.astype(jnp.float32) - dense)))
+            x = jax.random.normal(jax.random.PRNGKey(9), (256, 1024),
+                                  dtype=jnp.bfloat16)
+            w = jnp.ones((1024,), jnp.bfloat16)
+            x32 = x.astype(jnp.float32)
+            ref_rms = (x32 * jax.lax.rsqrt(
+                jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6)) * 1.0
+            rms_err = float(jnp.max(jnp.abs(
+                rms_norm_fused(x, w).astype(jnp.float32) - ref_rms)))
+
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(params))
+        tokens_per_step = batch * seq
+        # Training FLOPs: 6*N per token (fwd+bwd matmuls) + attention
+        # 12*L*S*D per token (QK^T + PV, fwd+bwd) — the scaling-book
+        # accounting.
+        flops_per_step = (6 * n_params
+                          + 12 * cfg.n_layers * seq * cfg.d_model
+                          ) * tokens_per_step
+        peak = _chip_peak_tflops(device) * 1e12
+        mfu = flops_per_step / (med * peak)
+        return {
+            "suite": "model_train_step",
+            "device": str(getattr(device, "device_kind", device.platform)),
+            "on_accelerator": on_accel,
+            "n_params": n_params,
+            "batch": batch, "seq": seq,
+            "step_time_s": med, "step_time_iqr_s": iqr, "repeats": repeats,
+            "tokens_per_sec": tokens_per_step / med,
+            "model_flops_per_step": flops_per_step,
+            "mfu": round(mfu, 4),
+            "peak_tflops_assumed": peak / 1e12,
+            "flash_attention_max_err": flash_err,
+            "rms_norm_fused_max_err": rms_err,
+        }
+    except Exception as e:  # noqa: BLE001 — suite optional until built
+        return {"suite": "model_train_step", "skipped": repr(e)}
+
+
 def bench_rl_rollout():
     """Config #5: PPO rollout collection, CartPole, 64 vectorized envs."""
     try:
@@ -181,7 +335,7 @@ def main():
     parser.add_argument("--all", action="store_true",
                         help="run every suite, print per-suite results")
     parser.add_argument("--suite", choices=[
-        "chain", "fanout", "actor", "data", "rl"], default=None)
+        "chain", "fanout", "actor", "data", "rl", "model"], default=None)
     parser.add_argument("--iters", type=int, default=10)
     args = parser.parse_args()
 
@@ -191,6 +345,7 @@ def main():
         "actor": bench_actor_pipeline,
         "data": bench_data_map_batches,
         "rl": bench_rl_rollout,
+        "model": bench_model_train_step,
     }
 
     if args.suite:
@@ -200,11 +355,17 @@ def main():
 
     chain = bench_chain(n_iters=args.iters)
     fanout = bench_fanout(n_iters=args.iters)
+    # Always capture the full breakdown (actor/data/rl/model) so the
+    # driver's single-line artifact carries every suite, with medians and
+    # spreads, not just the headline.
+    breakdown = {"chain": chain, "fanout": fanout}
+    for name in ("actor", "data", "rl", "model"):
+        try:
+            breakdown[name] = suites[name]()
+        except Exception as e:  # noqa: BLE001 — suite failure is data too
+            breakdown[name] = {"suite": name, "skipped": repr(e)}
     if args.all:
-        results = [chain, fanout]
-        for name in ("actor", "data", "rl"):
-            results.append(suites[name]())
-        for r in results:
+        for r in breakdown.values():
             print(json.dumps(r), file=sys.stderr)
 
     # Headline: total tasks over total wall time across chain + fan-out
@@ -217,6 +378,10 @@ def main():
         "value": round(tasks_per_sec, 1),
         "unit": "tasks/s",
         "vs_baseline": round(tasks_per_sec / NORTH_STAR_TASKS_PER_SEC, 3),
+        "repeats": chain.get("repeats"),
+        "sync_exec_p50_us": round(chain.get("sync_exec_p50_us", 0.0), 1),
+        "sync_exec_p99_us": round(chain.get("sync_exec_p99_us", 0.0), 1),
+        "suites": breakdown,
     }))
 
 

@@ -85,6 +85,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                 i64]
     lib.rtn_mo_close.restype = i32
     lib.rtn_mo_close.argtypes = [p, u64]
+    lib.rtn_mo_destroy.restype = i32
+    lib.rtn_mo_destroy.argtypes = [p, u64]
 
     lib.rtn_tq_create.restype = p
     lib.rtn_tq_create.argtypes = [u32, u32]

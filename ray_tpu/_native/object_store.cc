@@ -56,19 +56,32 @@ struct Entry {
   uint64_t ctrl_offset;  // MutableCtrl offset (mutable objects)
 };
 
+// Reclaimed arena blocks (delete/destroy) for reuse: a best-fit free list
+// with neighbor coalescing and end-of-arena giveback — the plasma-role
+// answer to long-running stores, where a pure bump allocator would leak
+// every staged argument and return payload forever.
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;  // aligned bytes
+};
+
+constexpr uint32_t kMaxFreeBlocks = 2048;
+
 struct Header {
   uint64_t magic;
   uint64_t arena_size;
   uint64_t alloc_cursor;     // bump allocator cursor
   uint32_t max_objects;
-  uint32_t pad;
+  uint32_t free_count;       // live entries in the free list
   uint64_t used_objects;
-  pthread_mutex_t table_mu;  // protects table + allocator
-  // Entry table follows; payload heap after that.
+  uint64_t free_bytes;       // total bytes parked in the free list
+  pthread_mutex_t table_mu;  // protects table + allocator + free list
+  // Free list, then entry table, then payload heap follow.
 };
 
 struct Store {
   Header* hdr;
+  FreeBlock* freelist;
   Entry* table;
   uint8_t* base;
   uint64_t mapped_size;
@@ -94,11 +107,77 @@ Entry* find_slot(Store* s, uint64_t id, bool for_insert) {
 }
 
 uint64_t arena_alloc(Store* s, uint64_t size) {
-  // Caller holds table_mu. Bump allocation; 0 on exhaustion.
-  uint64_t off = align8(s->hdr->alloc_cursor);
-  if (off + size > s->hdr->arena_size) return 0;
-  s->hdr->alloc_cursor = off + size;
+  // Caller holds table_mu. Best-fit from the free list, else bump; 0 on
+  // exhaustion.
+  Header* h = s->hdr;
+  uint64_t need = align8(size);
+  uint32_t best = UINT32_MAX;
+  uint64_t best_size = ~0ULL;
+  for (uint32_t i = 0; i < h->free_count; i++) {
+    uint64_t fs = s->freelist[i].size;
+    if (fs >= need && fs < best_size) {
+      best = i;
+      best_size = fs;
+      if (fs == need) break;
+    }
+  }
+  if (best != UINT32_MAX) {
+    FreeBlock b = s->freelist[best];
+    uint64_t rem = b.size - need;
+    if (rem > 0) {
+      // Keep the exact remainder (even slivers): absorbing it would make
+      // the reserved size differ from the entry's recorded capacity, so
+      // a later free would strand the tail bytes forever. Coalescing on
+      // free merges slivers back into neighbors.
+      s->freelist[best].offset = b.offset + need;
+      s->freelist[best].size = rem;
+      h->free_bytes -= need;
+    } else {
+      s->freelist[best] = s->freelist[--h->free_count];
+      h->free_bytes -= need;
+    }
+    return b.offset;
+  }
+  uint64_t off = align8(h->alloc_cursor);
+  if (off + need > h->arena_size) return 0;
+  h->alloc_cursor = off + need;
   return off;
+}
+
+void arena_free(Store* s, uint64_t off, uint64_t size) {
+  // Caller holds table_mu. Coalesce with free neighbors, give back blocks
+  // that touch the bump cursor, park the rest in the free list.
+  if (!off || !size) return;
+  Header* h = s->hdr;
+  uint64_t need = align8(size);
+  for (uint32_t i = 0; i < h->free_count;) {
+    FreeBlock* f = &s->freelist[i];
+    if (f->offset + f->size == off) {
+      off = f->offset;
+      need += f->size;
+      h->free_bytes -= f->size;
+      *f = s->freelist[--h->free_count];
+      continue;
+    }
+    if (off + need == f->offset) {
+      need += f->size;
+      h->free_bytes -= f->size;
+      *f = s->freelist[--h->free_count];
+      continue;
+    }
+    i++;
+  }
+  if (off + need == align8(h->alloc_cursor)) {
+    h->alloc_cursor = off;  // retreat the bump cursor
+    return;
+  }
+  if (h->free_count < kMaxFreeBlocks) {
+    s->freelist[h->free_count].offset = off;
+    s->freelist[h->free_count].size = need;
+    h->free_count++;
+    h->free_bytes += need;
+  }
+  // List full: the block leaks until the store is recreated.
 }
 
 void shared_mutex_init(pthread_mutex_t* mu) {
@@ -158,7 +237,9 @@ void* rtn_store_create(const char* name, uint64_t arena_size,
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
   uint64_t table_bytes = sizeof(Entry) * (uint64_t)max_objects;
-  uint64_t total = align8(sizeof(Header)) + align8(table_bytes) + arena_size;
+  uint64_t free_bytes_sz = align8(sizeof(FreeBlock) * (uint64_t)kMaxFreeBlocks);
+  uint64_t total = align8(sizeof(Header)) + free_bytes_sz
+                   + align8(table_bytes) + arena_size;
   if (ftruncate(fd, (off_t)total) != 0) { close(fd); return nullptr; }
   void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
@@ -166,13 +247,15 @@ void* rtn_store_create(const char* name, uint64_t arena_size,
 
   Store* s = new Store();
   s->hdr = (Header*)mem;
-  s->table = (Entry*)((uint8_t*)mem + align8(sizeof(Header)));
-  s->base = (uint8_t*)mem + align8(sizeof(Header)) + align8(table_bytes);
+  s->freelist = (FreeBlock*)((uint8_t*)mem + align8(sizeof(Header)));
+  s->table = (Entry*)((uint8_t*)mem + align8(sizeof(Header)) + free_bytes_sz);
+  s->base = (uint8_t*)s->table + align8(table_bytes);
   s->mapped_size = total;
   s->owner = 1;
   strncpy(s->name, name, sizeof(s->name) - 1);
 
   memset(s->hdr, 0, sizeof(Header));
+  memset(s->freelist, 0, free_bytes_sz);
   memset(s->table, 0, table_bytes);
   s->hdr->magic = kMagic;
   s->hdr->arena_size = arena_size;
@@ -196,8 +279,10 @@ void* rtn_store_open(const char* name) {
   Store* s = new Store();
   s->hdr = hdr;
   uint64_t table_bytes = sizeof(Entry) * (uint64_t)hdr->max_objects;
-  s->table = (Entry*)((uint8_t*)mem + align8(sizeof(Header)));
-  s->base = (uint8_t*)mem + align8(sizeof(Header)) + align8(table_bytes);
+  uint64_t free_bytes_sz = align8(sizeof(FreeBlock) * (uint64_t)kMaxFreeBlocks);
+  s->freelist = (FreeBlock*)((uint8_t*)mem + align8(sizeof(Header)));
+  s->table = (Entry*)((uint8_t*)mem + align8(sizeof(Header)) + free_bytes_sz);
+  s->base = (uint8_t*)s->table + align8(table_bytes);
   s->mapped_size = (uint64_t)st.st_size;
   s->owner = 0;
   strncpy(s->name, name, sizeof(s->name) - 1);
@@ -220,7 +305,8 @@ uint64_t rtn_store_capacity(void* handle) {
 }
 
 uint64_t rtn_store_used(void* handle) {
-  return ((Store*)handle)->hdr->alloc_cursor;
+  Header* h = ((Store*)handle)->hdr;
+  return h->alloc_cursor - h->free_bytes;
 }
 
 uint64_t rtn_store_num_objects(void* handle) {
@@ -240,7 +326,7 @@ int rtn_put(void* handle, uint64_t id, const uint8_t* data, uint64_t len) {
   if (!off && len > 0) { pthread_mutex_unlock(&s->hdr->table_mu); return RTN_ERR_FULL; }
   e->id = id;
   e->offset = off;
-  e->capacity = len;
+  e->capacity = align8(len);  // what arena_alloc reserved (arena_free needs it)
   e->size = len;
   e->ctrl_offset = 0;
   e->state = kSealed;
@@ -278,7 +364,12 @@ int rtn_delete(void* handle, uint64_t id) {
   lock_robust(&s->hdr->table_mu);
   Entry* e = find_slot(s, id, false);
   if (!e) { pthread_mutex_unlock(&s->hdr->table_mu); return RTN_ERR_NOT_FOUND; }
-  e->state = kTombstone;  // space reclaimed only on store re-create (v1)
+  if (e->state == kMutable) {  // mutable objects go through rtn_mo_destroy
+    pthread_mutex_unlock(&s->hdr->table_mu);
+    return RTN_ERR_STATE;
+  }
+  e->state = kTombstone;
+  arena_free(s, e->offset, e->capacity);
   s->hdr->used_objects--;
   pthread_mutex_unlock(&s->hdr->table_mu);
   return RTN_OK;
@@ -379,6 +470,12 @@ int rtn_mo_read(void* handle, uint64_t id, uint64_t last_seen,
     pthread_mutex_unlock(&c->mu);
     return RTN_ERR_CLOSED;
   }
+  if (c->closed == 2) {
+    // Destroyed (payload arena reclaimed): no drain — the bytes at
+    // e->offset may already belong to another object.
+    pthread_mutex_unlock(&c->mu);
+    return RTN_ERR_CLOSED;
+  }
   if (c->payload_size > buf_cap) {
     pthread_mutex_unlock(&c->mu);
     return RTN_ERR_FULL;
@@ -403,6 +500,29 @@ int rtn_mo_close(void* handle, uint64_t id) {
   c->closed = 1;
   pthread_cond_broadcast(&c->cv);
   pthread_mutex_unlock(&c->mu);
+  return RTN_OK;
+}
+
+int rtn_mo_destroy(void* handle, uint64_t id) {
+  // Close + reclaim the payload arena. The MutableCtrl block (mutex/cv
+  // memory a blocked peer may still reference) is intentionally leaked;
+  // read/write after close observe `closed` under the ctrl mutex and
+  // never touch the freed payload.
+  Store* s = (Store*)handle;
+  Entry* e; MutableCtrl* c;
+  int rc = mo_lookup(s, id, &e, &c);
+  if (rc != RTN_OK) return rc;
+  lock_robust(&c->mu);
+  c->closed = 2;  // destroyed: readers must not drain from the payload
+  pthread_cond_broadcast(&c->cv);
+  pthread_mutex_unlock(&c->mu);
+  lock_robust(&s->hdr->table_mu);
+  if (e->id == id && e->state == kMutable) {
+    e->state = kTombstone;
+    arena_free(s, e->offset, e->capacity);
+    s->hdr->used_objects--;
+  }
+  pthread_mutex_unlock(&s->hdr->table_mu);
   return RTN_OK;
 }
 

@@ -22,6 +22,12 @@ class NativeError(RuntimeError):
     def __init__(self, code: int, op: str):
         super().__init__(f"native {op} failed: {_ERRS.get(code, code)}")
         self.code = code
+        self.op = op
+
+    def __reduce__(self):
+        # Default exception reduce would call __init__(message) and crash
+        # when the error crosses a process boundary.
+        return (NativeError, (self.code, self.op))
 
 
 def _check(code: int, op: str):
@@ -139,6 +145,10 @@ class NativeObjectStore:
     def mo_close(self, object_id: int):
         _check(self._lib.rtn_mo_close(self._h, object_id), "mo_close")
 
+    def mo_destroy(self, object_id: int):
+        """Close + reclaim the payload arena (owner teardown path)."""
+        _check(self._lib.rtn_mo_destroy(self._h, object_id), "mo_destroy")
+
 
 class NativeMutableChannel:
     """Channel API over a native mutable object — the cross-process
@@ -163,21 +173,40 @@ class NativeMutableChannel:
         import pickle
 
         data = pickle.dumps(value, protocol=5)
-        self._store.mo_write(self.object_id, data,
-                             timeout_s=timeout if timeout else 60.0)
+        try:
+            self._store.mo_write(self.object_id, data,
+                                 timeout_s=timeout if timeout else 60.0)
+        except NativeError as e:
+            if e.code == -2:  # destroyed channel == closed to peers
+                raise ChannelError("channel destroyed") from None
+            raise
 
     def read(self, reader_id: int = 0, timeout: Optional[float] = None):
         import pickle
 
-        data, ver = self._store.mo_read(
-            self.object_id, self._last_seen[reader_id], self.max_size,
-            timeout_s=timeout if timeout else 60.0)
+        try:
+            data, ver = self._store.mo_read(
+                self.object_id, self._last_seen[reader_id], self.max_size,
+                timeout_s=timeout if timeout else 60.0)
+        except NativeError as e:
+            if e.code == -2:  # destroyed channel == closed to peers
+                raise ChannelError("channel destroyed") from None
+            raise
         self._last_seen[reader_id] = ver
         return pickle.loads(data)
 
     def close(self):
+        """Signal EOF; committed data stays readable (drain semantics)."""
         try:
             self._store.mo_close(self.object_id)
+        except NativeError:
+            pass
+
+    def destroy(self):
+        """Close + reclaim the payload arena — only when no peer can still
+        drain (e.g. the worker process on the other end is dead)."""
+        try:
+            self._store.mo_destroy(self.object_id)
         except NativeError:
             pass
 

@@ -133,15 +133,19 @@ _D("scheduler_top_k_fraction", float, 0.2,
 _D("lineage_pinning_enabled", bool, True,
    "Keep task specs for lineage reconstruction of lost objects.")
 _D("enable_timeline", bool, True, "Record task profile events for timeline.")
-_D("shm_store_bytes", int, 128 * 1024 * 1024,
+_D("shm_store_bytes", int, 256 * 1024 * 1024,
    "Shared-memory store segment size for the native object store.")
 _D("shm_store_slots", int, 4096,
    "Max concurrent objects in the native shared-memory store.")
 _D("use_native_queue", bool, True,
    "Route task dependency tracking through the C++ ready-ring when the "
    "native layer is available.")
-_D("worker_mode", str, "thread",
-   "Task execution plane: 'thread' (in-process pool) or 'process' "
-   "(spawned worker processes over the shm store).")
-_D("worker_channel_bytes", int, 4 * 1024 * 1024,
-   "Request/reply channel buffer size per worker process.")
+_D("worker_mode", str, "process",
+   "Task execution plane: 'process' (spawned worker processes over the shm "
+   "store — the default, matching the reference's process-isolated "
+   "workers) or 'thread' (in-process pool; used automatically when the "
+   "native layer is unavailable).")
+_D("worker_channel_bytes", int, 1024 * 1024,
+   "Request/reply channel buffer size per worker process (4 channels per "
+   "worker are resident in the shm store; larger blobs are staged as "
+   "regular shm objects instead of widening the channels).")

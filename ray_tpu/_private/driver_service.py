@@ -1,0 +1,202 @@
+"""Driver-side API service for worker processes (control-plane RPC).
+
+Rebuild of the owner/GCS RPC surface the reference gives every worker
+(reference roles: the CoreWorkerService RPCs a worker issues against its
+owner — SubmitTask, Get/Put via plasma + the GCS actor/KV services
+[unverified]). Worker processes are thin executors; every ``ray_tpu.*`` API
+call made *inside* a task (nested ``.remote()``, ``get``/``put``, actor
+method calls on handles passed into the task, runtime-context queries) is
+forwarded over a per-worker shared-memory channel pair back to the driver,
+which executes it against the real runtime and replies.
+
+One service thread runs per worker process (started by ``WorkerProcess``);
+requests are strictly serialized per worker (the client holds a lock), so
+the protocol needs no correlation ids. Payloads above the inline limit ride
+the shm object store instead of the channel.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, List, Optional
+
+_INLINE_LIMIT = 1 << 20  # replies bigger than this ride the shm store
+
+# Driver-side stage keys for oversized replies (distinct from the
+# 0xA4A0… task-arg range and the 0xA4B0… client range).
+_reply_counter = [0]
+_reply_lock = threading.Lock()
+
+
+def _next_reply_key() -> int:
+    with _reply_lock:
+        _reply_counter[0] += 1
+        return 0xA4C0_0000_0000_0000 | (_reply_counter[0] & 0xFFFF_FFFF_FFFF)
+
+
+def _pack_reply(shm_store, value_bytes: bytes):
+    """("ok", bytes) inline, or ("okshm", key) through the store."""
+    if shm_store is not None and len(value_bytes) > _INLINE_LIMIT:
+        key = _next_reply_key()
+        shm_store.put(key, value_bytes)
+        return ("okshm", key)
+    return ("ok", value_bytes)
+
+
+class _ServiceState:
+    """Per-worker pinned refs: objects a worker created/was promised stay
+    alive at least as long as the worker process (simplified borrower
+    protocol — the reference tracks borrowers precisely)."""
+
+    def __init__(self):
+        self.pinned: dict = {}  # oid -> ObjectRef
+
+    def pin(self, refs: List[Any]):
+        for r in refs:
+            self.pinned[r.object_id] = r
+
+    def clear(self):
+        self.pinned.clear()
+
+
+def handle_request(worker, shm_store, state: _ServiceState, msg: tuple):
+    """Dispatch one API request from a worker process. Returns the reply
+    tuple. Exceptions are caught by the caller and shipped back."""
+    import cloudpickle
+
+    from ray_tpu._private.ids import ActorID, ObjectID
+    from ray_tpu._private.worker import ObjectRef
+
+    kind = msg[0]
+    if kind == "api_ping":
+        return ("ok", None)
+    if kind == "api_put":
+        # (oid_bin, data | shm_key, is_shm): client assigned the oid.
+        _, oid_bin, payload, is_shm = msg
+        if is_shm:
+            data = bytes(shm_store.get(payload))
+            shm_store.delete(payload)
+        else:
+            data = payload
+        from ray_tpu._private.serialization import SerializedObject
+
+        oid = ObjectID(oid_bin)
+        worker.store.put(oid, SerializedObject.from_bytes(data))
+        state.pin([ObjectRef(oid)])
+        return ("ok", None)
+    if kind == "api_get":
+        _, oid_bin, timeout = msg
+        serialized = worker.store.get(ObjectID(oid_bin), timeout=timeout)
+        return _pack_reply(shm_store, serialized.to_bytes())
+    if kind == "api_wait":
+        _, oid_bins, num_returns, timeout = msg
+        ready, not_ready = worker.store.wait(
+            [ObjectID(b) for b in oid_bins], num_returns, timeout)
+        return ("ok", ([o.binary() for o in ready],
+                       [o.binary() for o in not_ready]))
+    if kind == "api_submit":
+        # Whole TaskSpec (function included) by value; ObjectRef args
+        # rehydrate as driver-side refs during unpickling.
+        _, spec_bytes = msg
+        spec = cloudpickle.loads(spec_bytes)
+        refs = worker.submit_task(spec)
+        state.pin(refs)
+        return ("ok", None)
+    if kind == "api_actor_submit":
+        _, actor_bin, method_name, args_bytes, num_returns, name = msg
+        runtime = worker.actors.get(ActorID(actor_bin))
+        if runtime is None:
+            raise ValueError("actor not found on the driver")
+        args, kwargs = cloudpickle.loads(args_bytes)
+        refs = runtime.submit(method_name, args, kwargs, num_returns,
+                              name or method_name)
+        state.pin(refs)
+        return ("ok", [r.object_id.binary() for r in refs])
+    if kind == "api_actor_create":
+        _, cls_bytes, args_bytes, opts = msg
+        from ray_tpu.actor import ActorClass
+
+        cls = cloudpickle.loads(cls_bytes)
+        args, kwargs = cloudpickle.loads(args_bytes)
+        handle = ActorClass(cls, dict(opts or {})).remote(*args, **kwargs)
+        return ("ok", handle._actor_id.binary())
+    if kind == "api_actor_named":
+        _, name, namespace = msg
+        from ray_tpu.actor import get_actor
+
+        handle = get_actor(name, namespace)
+        return ("ok", handle._actor_id.binary())
+    if kind == "api_kv":
+        _, op, key, value = msg
+        if op == "put":
+            return ("ok", worker.kv_put(key, value))
+        if op == "put_once":
+            return ("ok", worker.kv_put(key, value, overwrite=False))
+        if op == "get":
+            return ("ok", worker.kv_get(key))
+        if op == "del":
+            return ("ok", worker.kv_del(key))
+        if op == "keys":
+            return ("ok", worker.kv_keys(key or b""))
+        raise ValueError(f"unknown kv op {op!r}")
+    if kind == "api_resources":
+        _, which = msg
+        pool = worker.resource_pool
+        return ("ok", pool.available() if which == "available" else pool.total)
+    if kind == "api_ctx":
+        return ("ok", {
+            "job_id": worker.job_id.binary(),
+            "node_id": worker.node_id.binary(),
+            "namespace": getattr(worker, "namespace", "default"),
+        })
+    raise ValueError(f"unknown api request {msg[0]!r}")
+
+
+def service_loop(proc) -> None:
+    """Driver-side thread body: serve one worker's API channel until the
+    worker dies or the owner shuts the channel down."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.exceptions import ChannelError, ChannelTimeoutError
+
+    state = _ServiceState()
+    # Leave pickle-overhead headroom under the channel capacity; anything
+    # bigger rides the store as a whole-reply blob.
+    inline_limit = max(proc.max_msg // 4, 64 * 1024)
+    while not proc._svc_stop:
+        try:
+            msg = proc._api_req.read(timeout=0.25)
+        except ChannelTimeoutError:
+            if not proc.alive():
+                break
+            continue
+        except (ChannelError, Exception):  # noqa: BLE001 — torn down
+            break
+        worker = worker_mod._try_global_worker()
+        try:
+            if msg[0] == "api_blob":  # oversized request staged by client
+                raw = bytes(proc._store.get(msg[1]))
+                proc._store.delete(msg[1])
+                msg = pickle.loads(raw)
+            if worker is None or not worker.is_alive:
+                raise RuntimeError("driver runtime is shut down")
+            reply = handle_request(worker, proc._store, state, msg)
+        except BaseException as exc:  # noqa: BLE001 — error boundary
+            try:
+                reply = ("err", pickle.dumps(exc))
+            except Exception:  # noqa: BLE001 — unpicklable exception
+                reply = ("err", pickle.dumps(
+                    RuntimeError(f"{type(exc).__name__}: {exc}")))
+        try:
+            if len(pickle.dumps(reply, protocol=5)) > inline_limit:
+                key = _next_reply_key()
+                proc._store.put(key, pickle.dumps(reply, protocol=5))
+                reply = ("okshm_reply", key)
+        except Exception:  # noqa: BLE001 — unpicklable reply stays inline
+            pass
+        try:
+            proc._api_rep.write(reply, timeout=10.0)
+        except Exception:  # noqa: BLE001 — worker died mid-reply
+            if not proc.alive():
+                break
+    state.clear()

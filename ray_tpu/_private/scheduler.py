@@ -358,6 +358,8 @@ class LocalScheduler:
             pack_function,
         )
 
+        from ray_tpu._private.worker_pool import maybe_stage
+
         ctx = global_worker().serialization_context
         w = self._worker_pool.lease()
         staged: list = []
@@ -365,6 +367,12 @@ class LocalScheduler:
         try:
             digest, fn_bytes = pack_function(spec.function)
             payload, staged = pack_args(self._shm_store, ctx, args, kwargs)
+            # Oversized fields ride the store, not the (1MB) channel.
+            limit = max(w.max_msg // 4, 64 * 1024)
+            fn_bytes, st = maybe_stage(self._shm_store, fn_bytes, limit)
+            staged += st
+            payload, st = maybe_stage(self._shm_store, payload, limit)
+            staged += st
             # A prior attempt may have died AFTER storing outputs but
             # BEFORE replying; clear any stale ret keys so the worker's
             # put can't fail with "exists" on the retry.
@@ -374,7 +382,7 @@ class LocalScheduler:
             try:
                 w.request(
                     ("task", digest, fn_bytes, payload, ret_keys,
-                     spec.num_returns),
+                     spec.num_returns, spec.task_id.binary(), spec.name),
                     cancel_event=cancelled_event)
             finally:
                 with self._lock:

@@ -166,15 +166,20 @@ class Worker:
         self.shm_store = None
         self.worker_pool = None
         if self.worker_mode == "process":
-            from ray_tpu._native.store import NativeObjectStore
-            from ray_tpu._private.worker_pool import WorkerPool
+            try:
+                from ray_tpu._native.store import NativeObjectStore
+                from ray_tpu._private.worker_pool import WorkerPool
 
-            self.shm_store = NativeObjectStore.create(
-                capacity=GlobalConfig.shm_store_bytes,
-                max_objects=GlobalConfig.shm_store_slots)
-            self.worker_pool = WorkerPool(
-                self.shm_store, num_workers=max(int(num_cpus), 1),
-                max_msg=GlobalConfig.worker_channel_bytes)
+                self.shm_store = NativeObjectStore.create(
+                    capacity=GlobalConfig.shm_store_bytes,
+                    max_objects=GlobalConfig.shm_store_slots)
+                self.worker_pool = WorkerPool(
+                    self.shm_store, num_workers=max(int(num_cpus), 1),
+                    max_msg=GlobalConfig.worker_channel_bytes)
+            except Exception:  # noqa: BLE001 — no native toolchain: degrade
+                self.worker_mode = "thread"
+                self.shm_store = None
+                self.worker_pool = None
         self.scheduler = LocalScheduler(
             self.store, self.resource_pool, pool_size,
             task_events=self.task_events,
@@ -248,6 +253,10 @@ class Worker:
             self.scheduler.submit(spec)
         return refs
 
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]):
+        return self.store.wait(object_ids, num_returns, timeout)
+
     # -------------------------------------------------------- internal KV ---
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:
         with self._kv_lock:
@@ -270,9 +279,18 @@ class Worker:
 
     def shutdown(self):
         self.is_alive = False
-        for actor in list(self.actors.values()):
+        actors = list(self.actors.values())
+        for actor in actors:
             try:
                 actor.terminate(no_restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+        for actor in actors:
+            # Join the loop threads BEFORE the shm store unmaps: a
+            # process-actor loop tears its channels down on _TERMINATE and
+            # must not race the munmap.
+            try:
+                actor.join(timeout=2)
             except Exception:  # noqa: BLE001
                 pass
         self.actors.clear()
@@ -384,7 +402,7 @@ def wait(refs: List[ObjectRef], *, num_returns: int = 1,
         raise ValueError(
             f"num_returns ({num_returns}) exceeds number of refs "
             f"({len(refs)})")
-    ready_ids, not_ready_ids = worker.store.wait(
+    ready_ids, not_ready_ids = worker.wait(
         [r.object_id for r in refs], num_returns, timeout)
     by_id = {r.object_id: r for r in refs}
     return ([by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids])

@@ -30,6 +30,14 @@ class _ShmRef:
         self.key = key
 
 
+def _fetch_blob(store, field):
+    """Inverse of worker_pool.maybe_stage: ('shm', key) markers resolve
+    through the store (the driver deletes the key after the reply)."""
+    if isinstance(field, tuple) and len(field) == 2 and field[0] == "shm":
+        return bytes(store.get(field[1]))
+    return field
+
+
 def _load_payload(store, ctx, payload: bytes):
     """Deserialize (args, kwargs), fetching _ShmRef args from the store."""
     from ray_tpu._private.serialization import SerializedObject
@@ -61,7 +69,8 @@ def _store_outputs(store, ctx, return_keys: List[int], result: Any,
 
 
 def worker_loop(store_name: str, req_id: int, rep_id: int,
-                worker_id: int, max_msg: int) -> None:
+                worker_id: int, max_msg: int,
+                api_req_id: int = 0, api_rep_id: int = 0) -> None:
     # Workers never touch the TPU: the device belongs to the driver (the
     # compiled-graph path); keep jax (if imported by user code) on CPU.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -69,6 +78,8 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
     import cloudpickle
 
     from ray_tpu._native.store import NativeMutableChannel, NativeObjectStore
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.ids import TaskID
     from ray_tpu._private.serialization import SerializationContext
     from ray_tpu.exceptions import ChannelError, ChannelTimeoutError, \
         RayTaskError
@@ -79,9 +90,28 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
     rep = NativeMutableChannel(store, rep_id, max_size=max_msg,
                                num_readers=1, create=False)
 
+    # Install the client-mode runtime so ray_tpu.* API calls made inside
+    # task/actor code forward to the driver instead of booting a second
+    # full runtime in this process.
+    if api_req_id and api_rep_id:
+        from ray_tpu._private.client_worker import ClientWorker
+
+        api_req = NativeMutableChannel(store, api_req_id, max_size=max_msg,
+                                       num_readers=1, create=False)
+        api_rep = NativeMutableChannel(store, api_rep_id, max_size=max_msg,
+                                       num_readers=1, create=False)
+        worker_mod._global_worker = ClientWorker(
+            store, api_req, api_rep, worker_id)
+
     ctx = SerializationContext()
     fn_cache: Dict[bytes, Any] = {}
     actor_instance: Optional[Any] = None
+    _stage_counter = [0]
+
+    def _set_task_ctx(task_id_bin, name):
+        worker_mod._task_context.current_task_id = (
+            TaskID(task_id_bin) if task_id_bin else None)
+        worker_mod._task_context.task_name = name
 
     while True:
         try:
@@ -102,30 +132,58 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
             elif kind == "ping":
                 rep.write(("ok", os.getpid()))
             elif kind == "task":
-                _, digest, fn_bytes, payload, return_keys, num_returns = msg
+                (_, digest, fn_bytes, payload, return_keys, num_returns,
+                 task_id_bin, name) = msg
                 fn = fn_cache.get(digest)
                 if fn is None:
-                    fn = cloudpickle.loads(fn_bytes)
+                    fn = cloudpickle.loads(_fetch_blob(store, fn_bytes))
                     fn_cache[digest] = fn
-                args, kwargs = _load_payload(store, ctx, payload)
-                result = fn(*args, **kwargs)
+                args, kwargs = _load_payload(store, ctx,
+                                             _fetch_blob(store, payload))
+                _set_task_ctx(task_id_bin, name)
+                try:
+                    result = fn(*args, **kwargs)
+                finally:
+                    _set_task_ctx(None, None)
                 _store_outputs(store, ctx, return_keys, result, num_returns)
                 rep.write(("ok", None))
             elif kind == "actor_new":
                 _, cls_bytes, payload = msg
-                cls = cloudpickle.loads(cls_bytes)
-                args, kwargs = _load_payload(store, ctx, payload)
+                cls = cloudpickle.loads(_fetch_blob(store, cls_bytes))
+                args, kwargs = _load_payload(store, ctx,
+                                             _fetch_blob(store, payload))
                 actor_instance = cls(*args, **kwargs)
                 rep.write(("ok", None))
             elif kind == "actor_call":
-                _, method_name, payload, return_keys, num_returns = msg
+                (_, method_name, payload, return_keys, num_returns,
+                 task_id_bin, name) = msg
                 if actor_instance is None:
                     raise RuntimeError("actor_call before actor_new")
                 method = getattr(actor_instance, method_name)
-                args, kwargs = _load_payload(store, ctx, payload)
-                result = method(*args, **kwargs)
-                _store_outputs(store, ctx, return_keys, result, num_returns)
-                rep.write(("ok", None))
+                args, kwargs = _load_payload(store, ctx,
+                                             _fetch_blob(store, payload))
+                _set_task_ctx(task_id_bin, name)
+                try:
+                    result = method(*args, **kwargs)
+                finally:
+                    _set_task_ctx(None, None)
+                if return_keys:
+                    _store_outputs(store, ctx, return_keys, result,
+                                   num_returns)
+                    rep.write(("ok", None))
+                else:
+                    # Proxy apply (DAG exec loop): result rides the reply;
+                    # big results stage through the store instead.
+                    raw = ctx.serialize(result).to_bytes()
+                    if len(raw) > max(max_msg // 4, 64 * 1024):
+                        _stage_counter[0] += 1
+                        key = (0xA4D0_0000_0000_0000
+                               | (os.getpid() & 0xFFFFFF) << 24
+                               | (_stage_counter[0] & 0xFF_FFFF))
+                        store.put(key, raw)
+                        rep.write(("okshm", key))
+                    else:
+                        rep.write(("ok", raw))
             else:
                 raise ValueError(f"unknown request kind {kind!r}")
         except BaseException as exc:  # noqa: BLE001 — worker error boundary
@@ -144,11 +202,13 @@ def main(argv=None) -> int:
     ap.add_argument("--store", required=True)
     ap.add_argument("--req-id", type=int, required=True)
     ap.add_argument("--rep-id", type=int, required=True)
+    ap.add_argument("--api-req-id", type=int, default=0)
+    ap.add_argument("--api-rep-id", type=int, default=0)
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--max-msg", type=int, default=4 << 20)
     args = ap.parse_args(argv)
     worker_loop(args.store, args.req_id, args.rep_id, args.worker_id,
-                args.max_msg)
+                args.max_msg, args.api_req_id, args.api_rep_id)
     return 0
 
 

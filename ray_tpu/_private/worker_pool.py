@@ -31,7 +31,7 @@ from ray_tpu.exceptions import (
     WorkerPoolExhaustedError,
 )
 
-_INLINE_LIMIT = 512 * 1024  # args bigger than this ride the shm store
+_INLINE_LIMIT = 256 * 1024  # args bigger than this ride the shm store
 
 
 class WorkerProcess:
@@ -55,23 +55,53 @@ class WorkerProcess:
                 | (os.getpid() & 0xFFFF) << 24 | self.worker_id << 4)
         self._req_id = base | 1
         self._rep_id = base | 2
+        self._api_req_id = base | 3
+        self._api_rep_id = base | 5
         self._req = NativeMutableChannel(
             store, self._req_id, max_size=max_msg, num_readers=1)
         self._rep = NativeMutableChannel(
             store, self._rep_id, max_size=max_msg, num_readers=1)
+        # Reverse API channel pair: ray_tpu.* calls made inside the worker
+        # forward to the driver's service thread (driver_service.py).
+        self._api_req = NativeMutableChannel(
+            store, self._api_req_id, max_size=max_msg, num_readers=1)
+        self._api_rep = NativeMutableChannel(
+            store, self._api_rep_id, max_size=max_msg, num_readers=1)
         cmd = [
             sys.executable, "-m", "ray_tpu._private.worker_main",
             "--store", store.name,
             "--req-id", str(self._req_id),
             "--rep-id", str(self._rep_id),
+            "--api-req-id", str(self._api_req_id),
+            "--api-rep-id", str(self._api_rep_id),
             "--worker-id", str(self.worker_id),
             "--max-msg", str(max_msg),
         ]
         full_env = dict(os.environ)
         if env:
             full_env.update(env)
+        # Workers never spawn their own pools (the driver owns the process
+        # plane), and they must be able to import test/user modules the
+        # driver loaded from sys.path-only locations.
+        full_env["RAY_TPU_WORKER_MODE"] = "thread"
+        # Workers never touch the TPU; dropping the axon trigger skips the
+        # sitecustomize jax/PJRT registration (~2.2s of the ~2.4s worker
+        # boot) so the pool spins up in ~0.2s per process.
+        full_env.pop("PALLAS_AXON_POOL_IPS", None)
+        full_env.setdefault("JAX_PLATFORMS", "cpu")
+        extra_path = [p for p in sys.path if p]
+        prev = full_env.get("PYTHONPATH", "")
+        full_env["PYTHONPATH"] = os.pathsep.join(
+            extra_path + ([prev] if prev else []))
         self.proc = subprocess.Popen(cmd, env=full_env)
         self._dead = False
+        self._svc_stop = False
+        from ray_tpu._private.driver_service import service_loop
+
+        self._svc_thread = threading.Thread(
+            target=service_loop, args=(self,), daemon=True,
+            name=f"ray_tpu_api_svc_{self.worker_id}")
+        self._svc_thread.start()
 
     @property
     def pid(self) -> int:
@@ -111,16 +141,22 @@ class WorkerProcess:
                         f"(exit code {self.proc.returncode})")
         if status == "err":
             raise pickle.loads(value)
+        if status == "okshm":
+            data = bytes(self._store.get(value))
+            self._store.delete(value)
+            return data
         return value
 
     def kill(self):
         self._dead = True
+        self._svc_stop = True
         try:
             self.proc.kill()
         except Exception:  # noqa: BLE001
             pass
 
     def shutdown(self, timeout: float = 2.0):
+        self._svc_stop = True
         if self.alive():
             try:
                 self._req.write(("exit",), timeout=0.5)
@@ -129,34 +165,65 @@ class WorkerProcess:
                 self.kill()
         else:
             self.kill()
-        self._req.close()
-        self._rep.close()
+        self._svc_thread.join(timeout=1.0)
+        # The worker is dead: reclaim the channel arenas in the shm store.
+        for ch in (self._req, self._rep, self._api_req, self._api_rep):
+            ch.destroy()
 
 
 class WorkerPool:
     """Prestarted worker processes with lease/return + crash replacement."""
 
-    def __init__(self, store, num_workers: int, max_msg: int = 4 << 20):
+    def __init__(self, store, num_workers: int, max_msg: int = 4 << 20,
+                 max_workers: Optional[int] = None):
         self._store = store
         self._max_msg = max_msg
         self._lock = threading.Lock()
         self._idle: "queue.Queue[WorkerProcess]" = queue.Queue()
         self._all: List[WorkerProcess] = []
         self._shutdown = False
+        # Elastic cap: blocked workers (nested get() inside a task) hold
+        # their lease, so the pool grows past the base size rather than
+        # deadlocking — the reference's dynamic worker-start behavior.
+        self._max_workers = max_workers or max(num_workers * 4, num_workers)
         for _ in range(num_workers):
             w = WorkerProcess(store, max_msg=max_msg)
             self._all.append(w)
             self._idle.put(w)
 
     def lease(self, timeout: float = 60.0) -> WorkerProcess:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
         while True:
             try:
-                w = self._idle.get(timeout=timeout)
+                w = self._idle.get(timeout=0.5)
             except queue.Empty:
-                raise WorkerPoolExhaustedError(
-                    f"no idle worker within {timeout:.0f}s "
-                    f"(pool size {self.size}); long-running tasks may be "
-                    f"holding every worker") from None
+                with self._lock:
+                    can_grow = (not self._shutdown
+                                and len(self._all) < self._max_workers)
+                if can_grow:
+                    try:
+                        # Spawn OUTSIDE the lock (process startup must not
+                        # stall concurrent leases) and degrade to waiting
+                        # if the shm store can't fit more channel arenas.
+                        fresh = WorkerProcess(self._store,
+                                              max_msg=self._max_msg)
+                    except Exception:  # noqa: BLE001 — e.g. store full
+                        fresh = None
+                    if fresh is not None:
+                        with self._lock:
+                            if self._shutdown:
+                                fresh.shutdown(timeout=0.1)
+                            else:
+                                self._all.append(fresh)
+                                return fresh
+                if _time.monotonic() >= deadline:
+                    raise WorkerPoolExhaustedError(
+                        f"no idle worker within {timeout:.0f}s "
+                        f"(pool size {self.size}); long-running tasks may "
+                        f"be holding every worker") from None
+                continue
             if w.alive():
                 return w
             # Crashed while idle: replace and retry.
@@ -193,12 +260,35 @@ class WorkerPool:
             return [w.pid for w in self._all]
 
     def shutdown(self):
+        import time as _time
+
         with self._lock:
             self._shutdown = True
             workers = list(self._all)
             self._all.clear()
+        # Broadcast exits first, then reap against one shared deadline —
+        # a serial per-worker wait turns every teardown into seconds.
         for w in workers:
-            w.shutdown(timeout=0.5)
+            w._svc_stop = True
+            if w.alive():
+                try:
+                    w._req.write(("exit",), timeout=0.05)
+                except Exception:  # noqa: BLE001
+                    w.kill()
+            else:
+                w.kill()
+        deadline = _time.monotonic() + 1.0
+        for w in workers:
+            while w.proc.poll() is None and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            if w.proc.poll() is None:
+                w.kill()
+            w._svc_thread.join(timeout=0.5)
+            for ch in (w._req, w._rep, w._api_req, w._api_rep):
+                try:
+                    ch.destroy()
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 # ---------------------------------------------------------------------------
@@ -237,8 +327,14 @@ def pack_function(fn) -> Tuple[bytes, bytes]:
 
 
 def oid_key(object_id) -> int:
-    """Stable u64 key for an ObjectID in the shm store."""
-    return int.from_bytes(object_id.binary()[:8], "little")
+    """Stable u64 key for an ObjectID in the shm store.
+
+    Hashes the FULL id: the first 8 bytes alone are the task id prefix,
+    shared by every return of a multi-return task."""
+    digest = hashlib.blake2b(object_id.binary(), digest_size=8).digest()
+    # Clear the top nibble so keys never collide with the reserved channel
+    # (0xC…) and staging (0xA…) ranges.
+    return int.from_bytes(digest, "little") & 0x0FFF_FFFF_FFFF_FFFF
 
 
 _stage_counter = [0]
@@ -249,6 +345,30 @@ def _next_stage_key() -> int:
     with _stage_lock:
         _stage_counter[0] += 1
         return 0xA4A0_0000_0000_0000 | (_stage_counter[0] & 0xFFFF_FFFF_FFFF)
+
+
+def stage_blob(store, data: bytes) -> Tuple[Tuple[str, int], int]:
+    """Stage an oversized message blob (function bytes / packed payload) in
+    the shm store; returns the ('shm', key) marker and the key to delete
+    after the reply."""
+    key = _next_stage_key()
+    store.put(key, data)
+    return ("shm", key), key
+
+
+def maybe_stage(store, data: bytes, limit: int):
+    """Inline small blobs; stage big ones. Returns (field, staged_keys)."""
+    if len(data) <= limit:
+        return data, []
+    marker, key = stage_blob(store, data)
+    return marker, [key]
+
+
+def fetch_blob(store, field) -> bytes:
+    """Worker-side inverse of maybe_stage (driver deletes staged keys)."""
+    if isinstance(field, tuple) and len(field) == 2 and field[0] == "shm":
+        return bytes(store.get(field[1]))
+    return field
 
 
 def pack_args(store, ctx, args, kwargs) -> Tuple[bytes, List[int]]:

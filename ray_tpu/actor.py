@@ -83,6 +83,18 @@ class _ActorRuntime:
         if max_concurrency is None:
             max_concurrency = 1000 if self.is_async else 1
         self.max_concurrency = max(int(max_concurrency), 1)
+        # Process plane: plain sync actors live in a dedicated worker
+        # process (reference: every actor is a worker process), so an actor
+        # segfault/kill -9 never touches the driver. Async and
+        # multi-threaded actors keep the in-driver loop (their concurrency
+        # contract needs shared-memory threads, not a serialized channel).
+        worker = global_worker()
+        self.use_process = (
+            getattr(worker, "shm_store", None) is not None
+            and not self.is_async and self.max_concurrency == 1)
+        self._proc = None
+        self._restart_pending = False
+        self.pid: Optional[int] = None
         self._start_loop()
 
     # ---------------------------------------------------------------- loops
@@ -90,7 +102,10 @@ class _ActorRuntime:
         self._instance_ready = threading.Event()
         self._init_error: Optional[BaseException] = None
         mailbox = self._mailbox
-        target = self._run_async if self.is_async else self._run_sync
+        if self.use_process:
+            target = self._run_proc
+        else:
+            target = self._run_async if self.is_async else self._run_sync
         self._thread = threading.Thread(
             target=target, args=(mailbox,),
             daemon=True, name=f"actor-{self.class_name}",
@@ -171,6 +186,209 @@ class _ActorRuntime:
 
         loop.run_until_complete(_main())
         loop.close()
+
+    # ------------------------------------------------- process-backed actor
+    def _spawn_proc(self):
+        """Spawn the dedicated worker process and construct the instance in
+        it (fresh state). Raises on construction failure."""
+        import cloudpickle
+
+        from ray_tpu._private.worker_pool import (
+            WorkerProcess,
+            maybe_stage,
+            pack_args,
+        )
+
+        worker = global_worker()
+        proc = WorkerProcess(worker.shm_store,
+                             max_msg=GlobalConfig.worker_channel_bytes)
+        staged = []
+        try:
+            args, kwargs = _resolve_values(
+                worker, self.init_args, self.init_kwargs)
+            payload, staged = pack_args(
+                worker.shm_store, worker.serialization_context, args, kwargs)
+            limit = max(proc.max_msg // 4, 64 * 1024)
+            cls_bytes, st = maybe_stage(
+                worker.shm_store, cloudpickle.dumps(self.cls), limit)
+            staged += st
+            payload, st = maybe_stage(worker.shm_store, payload, limit)
+            staged += st
+            proc.request(("actor_new", cls_bytes, payload))
+        except BaseException:
+            proc.shutdown(timeout=0.1)
+            raise
+        finally:
+            for key in staged:
+                try:
+                    worker.shm_store.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+        return proc
+
+    def _run_proc(self, mailbox):
+        worker = global_worker()
+        try:
+            self._proc = self._spawn_proc()
+            self.pid = self._proc.pid
+            self._init_error = None
+        except BaseException as e:  # noqa: BLE001 — init error boundary
+            self._init_error = e
+            self.dead = True
+            self.death_cause = f"__init__ failed: {e!r}"
+            self._instance_ready.set()
+            self._drain_with_error(mailbox)
+            return
+        # DAG exec loops see a proxy whose method calls RPC into the worker
+        # process on this thread — same serialization contract as in-driver
+        # actors.
+        self.instance = _ProcessActorProxy(self)
+        self._instance_ready.set()
+        while True:
+            call = mailbox.get()
+            if call is _TERMINATE:
+                if self._proc is not None:
+                    self._proc.shutdown(timeout=0.5)
+                return
+            if isinstance(call, _ClosureCall):
+                try:
+                    call.fn(self.instance)
+                except Exception:  # noqa: BLE001 — exec loop boundary
+                    pass
+                continue
+            if self._restart_pending and not self.dead:
+                try:
+                    self._proc.shutdown(timeout=0.1)
+                    self._proc = self._spawn_proc()
+                    self.pid = self._proc.pid
+                except BaseException as e:  # noqa: BLE001
+                    self.dead = True
+                    self.death_cause = f"restart failed: {e!r}"
+                finally:
+                    self._restart_pending = False
+            if self.dead:
+                self._fail_call(worker, call, ActorDiedError(
+                    self.actor_id, self.death_cause or "actor is dead"))
+                continue
+            self._execute_call_proc(worker, call)
+
+    def _execute_call_proc(self, worker, call: _MethodCall):
+        from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu._private.worker_pool import (
+            maybe_stage,
+            oid_key,
+            pack_args,
+        )
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        if call.cancelled:
+            self._fail_call(worker, call, TaskCancelledError())
+            return
+        shm = worker.shm_store
+        task_id = call.return_ids[0].task_id()
+        worker.task_events.record(task_id, "RUNNING", name=call.name)
+        staged: list = []
+        ret_keys = [oid_key(oid) for oid in call.return_ids]
+        try:
+            args, kwargs = _resolve_actor_args(worker, call)
+            payload, staged = pack_args(
+                shm, worker.serialization_context, args, kwargs)
+            payload, st = maybe_stage(
+                shm, payload, max(self._proc.max_msg // 4, 64 * 1024))
+            staged += st
+            for key in ret_keys:  # clear stale keys from a crashed attempt
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._proc.request(
+                ("actor_call", call.method_name, payload, ret_keys,
+                 len(call.return_ids), task_id.binary(), call.name))
+            for oid, key in zip(call.return_ids, ret_keys):
+                raw = bytes(shm.get(key))
+                worker.store.put(oid, SerializedObject.from_bytes(raw))
+                shm.delete(key)
+            worker.task_events.record(task_id, "FINISHED", name=call.name)
+        except WorkerCrashedError as e:
+            self._on_proc_crash(worker, call, e)
+            worker.task_events.record(task_id, "FAILED", name=call.name)
+        except BaseException as exc:  # noqa: BLE001 — method error boundary
+            if isinstance(exc, RayTaskError):
+                self._fail_call(worker, call, exc)
+            else:
+                self._fail_call(
+                    worker, call, RayTaskError.from_exception(call.name, exc))
+            worker.task_events.record(task_id, "FAILED", name=call.name)
+        finally:
+            for key in staged:
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _proxy_apply(self, method_name: str, args, kwargs):
+        """Synchronous method application for _ProcessActorProxy (runs on
+        the actor loop thread; the result rides the reply channel)."""
+        from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu._private.worker_pool import maybe_stage, pack_args
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        worker = global_worker()
+        if self.dead or self._proc is None or not self._proc.alive():
+            raise ActorDiedError(self.actor_id,
+                                 self.death_cause or "actor is dead")
+        shm = worker.shm_store
+        payload, staged = pack_args(
+            shm, worker.serialization_context, args, kwargs)
+        payload, st = maybe_stage(
+            shm, payload, max(self._proc.max_msg // 4, 64 * 1024))
+        staged += st
+        try:
+            raw = self._proc.request(
+                ("actor_call", method_name, payload, [], 1, b"",
+                 method_name))
+            return worker.serialization_context.deserialize(
+                SerializedObject.from_bytes(raw))
+        except RayTaskError as e:
+            # Surface the original exception type — the DAG stage wraps it
+            # exactly once, like the in-driver path.
+            raise e.as_instanceof_cause() from None
+        except WorkerCrashedError as e:
+            self.dead = True
+            self.death_cause = f"actor worker process died: {e}"
+            raise ActorDiedError(self.actor_id, self.death_cause) from e
+        finally:
+            for key in staged:
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _on_proc_crash(self, worker, call: _MethodCall, exc: BaseException):
+        """The actor's worker died mid-call: fail the in-flight call, then
+        restart with fresh state if the policy allows (reference actor
+        restart semantics — the interrupted call is NOT retried)."""
+        self._fail_call(worker, call, ActorDiedError(
+            self.actor_id, f"actor worker process died: {exc}"))
+        if self._restart_pending:
+            consume = False  # terminate(no_restart=False) already counted it
+        elif not self.dead and self.restarts_used < self.max_restarts:
+            consume = True
+        else:
+            self.dead = True
+            self.death_cause = (self.death_cause
+                                or f"actor worker process died: {exc}")
+            return
+        self._restart_pending = False
+        if consume:
+            self.restarts_used += 1
+        try:
+            self._proc.shutdown(timeout=0.1)
+            self._proc = self._spawn_proc()
+            self.pid = self._proc.pid
+        except BaseException as e:  # noqa: BLE001
+            self.dead = True
+            self.death_cause = f"restart failed: {e!r}"
 
     # ------------------------------------------------------------ execution
     def _execute_call(self, worker, call: _MethodCall):
@@ -278,6 +496,13 @@ class _ActorRuntime:
         with self._lock:
             if not no_restart and self.restarts_used < self.max_restarts:
                 self.restarts_used += 1
+                if self.use_process:
+                    # Kill the worker (interrupting any in-flight call); the
+                    # loop respawns a fresh process before the next call.
+                    self._restart_pending = True
+                    if self._proc is not None:
+                        self._proc.kill()
+                    return
                 # Fresh mailbox for the restarted loop; the old loop drains
                 # its own mailbox and exits on the _TERMINATE sentinel.
                 old_mailbox = self._mailbox
@@ -287,10 +512,47 @@ class _ActorRuntime:
                 return
             self.dead = True
             self.death_cause = "killed via ray_tpu.kill()"
+            if self.use_process and self._proc is not None:
+                self._proc.kill()
             self._mailbox.put(_TERMINATE)
 
     def join(self, timeout=None):
         self._thread.join(timeout)
+
+
+class _ProcessActorProxy:
+    """Stand-in for ``runtime.instance`` on process-backed actors: method
+    access returns a callable that synchronously RPCs into the actor's
+    worker process (used by compiled-DAG exec loops, which run driver-side
+    but must execute stages against the real actor state)."""
+
+    __slots__ = ("_rt",)
+
+    def __init__(self, runtime: "_ActorRuntime"):
+        self._rt = runtime
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        rt = self._rt
+
+        def _call(*args, **kwargs):
+            return rt._proxy_apply(name, args, kwargs)
+
+        _call.__name__ = name
+        return _call
+
+
+def _resolve_values(worker, args, kwargs):
+    """Resolve top-level ObjectRefs to values (actor init/arg semantics)."""
+
+    def _resolve(v):
+        if isinstance(v, ObjectRef):
+            return worker.get_object(v)
+        return v
+
+    return (tuple(_resolve(a) for a in args),
+            {k: _resolve(v) for k, v in kwargs.items()})
 
 
 def _resolve_actor_args(worker, call: _MethodCall):
@@ -368,6 +630,12 @@ class ActorHandle:
 
 def _rebuild_handle(actor_id: ActorID) -> ActorHandle:
     worker = global_worker()
+    from ray_tpu._private.client_worker import ClientActorHandle, ClientWorker
+
+    if isinstance(worker, ClientWorker):
+        # Handle crossed into a worker process: method calls go back
+        # through the driver's API service.
+        return ClientActorHandle(actor_id)
     runtime = worker.actors.get(actor_id)
     if runtime is None:
         raise RayActorError(actor_id, "actor not found on this node")
@@ -386,6 +654,16 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = auto_init()
+        from ray_tpu._private.client_worker import (
+            ClientActorHandle,
+            ClientWorker,
+        )
+
+        if isinstance(worker, ClientWorker):
+            # Inside a worker process: the driver owns all actor runtimes.
+            actor_id = worker.actor_create(
+                self._cls, args, kwargs, self._options)
+            return ClientActorHandle(actor_id, self._cls.__name__)
         opts = self._options
         actor_name = opts.get("name")
         namespace = opts.get("namespace",
@@ -432,6 +710,10 @@ class ActorClass:
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     worker = global_worker()
+    from ray_tpu._private.client_worker import ClientActorHandle, ClientWorker
+
+    if isinstance(worker, ClientWorker):
+        return ClientActorHandle(worker.actor_named(name, namespace), name)
     ns = namespace or getattr(worker, "namespace", "default")
     handle = worker.named_actors.get((ns, name))
     if handle is None or handle._runtime.dead:

@@ -1,0 +1,261 @@
+"""Demand-driven autoscaler over the simulated cluster.
+
+Rebuild of the reference's autoscaler (reference roles:
+python/ray/autoscaler/_private/autoscaler.py StandardAutoscaler +
+monitor.py + the resource-demand scheduler [unverified]). A monitor thread
+watches three demand signals — infeasible task submissions, unplaceable
+placement groups, and explicit ``request_resources`` asks — plus scheduler
+backlog pressure, bin-packs the unmet shapes onto configured node types,
+launches simulated nodes (respecting per-type ``min_workers``/
+``max_workers``), and terminates nodes that have sat idle past the idle
+timeout, never dropping below ``min_workers``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.cluster_utils import Cluster, SimNode
+
+
+@dataclass
+class NodeTypeConfig:
+    """One launchable node shape (reference: available_node_types entry)."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class _NodeMeta:
+    type_name: str
+    idle_since: Optional[float] = None  # None = busy
+
+
+class AutoscalingCluster(Cluster):
+    """A Cluster that grows and shrinks with demand.
+
+    Tasks whose resource shape no current node can ever satisfy are parked
+    (instead of failing, as the fixed Cluster does) until the monitor
+    provisions a node type that fits; same for placement groups.
+    """
+
+    def __init__(self, node_types: List[NodeTypeConfig],
+                 head_resources: Optional[Dict[str, float]] = None,
+                 idle_timeout_s: float = 2.0,
+                 update_interval_s: float = 0.1):
+        head = dict(head_resources or {"CPU": 1})
+        super().__init__(initialize_head=True,
+                         head_node_args={"num_cpus": int(head.get("CPU", 1)),
+                                         "resources": {k: v
+                                                       for k, v in head.items()
+                                                       if k != "CPU"}})
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self._interval = update_interval_s
+        self._meta: Dict[SimNode, _NodeMeta] = {}
+        self._pending_specs: List[Any] = []
+        self._pending_pgs: List[Any] = []
+        self._requested: List[Dict[str, float]] = []
+        self._as_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.launched: List[str] = []    # type names, launch order
+        self.terminated: List[str] = []  # type names, termination order
+        for t in node_types:
+            for _ in range(t.min_workers):
+                self._launch(t)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="ray_tpu_autoscaler")
+        self._monitor.start()
+
+    # ------------------------------------------------------------ demand in
+    def submit(self, spec):
+        try:
+            super().submit(spec)
+        except RuntimeError:
+            if not self._fits_some_type(spec.resources):
+                raise  # no configured node type can EVER satisfy this
+            # Infeasible today: park it; the monitor provisions a node type
+            # that fits and resubmits (upstream queues in the raylet and
+            # the autoscaler sees it via resource_demand).
+            with self._as_lock:
+                self._pending_specs.append(spec)
+
+    def reserve_placement_group(self, pg):
+        try:
+            super().reserve_placement_group(pg)
+        except ValueError:
+            if not all(self._fits_some_type(b) for b in pg.bundles):
+                raise  # a bundle no node type can ever host
+            with self._as_lock:
+                self._pending_pgs.append(pg)
+
+    def request_resources(self, bundles: List[Dict[str, float]]):
+        """Explicit demand floor (reference: autoscaler sdk
+        request_resources): provision capacity for these shapes even with
+        no tasks submitted yet."""
+        with self._as_lock:
+            self._requested = [dict(b) for b in bundles]
+
+    # --------------------------------------------------------- provisioning
+    def _launch(self, t: NodeTypeConfig) -> Optional[SimNode]:
+        count = sum(1 for m in self._meta.values() if m.type_name == t.name)
+        if count >= t.max_workers:
+            return None
+        res = dict(t.resources)
+        node = self.add_node(num_cpus=int(res.pop("CPU", 1)), resources=res)
+        self._meta[node] = _NodeMeta(t.name)
+        self.launched.append(t.name)
+        return node
+
+    def _terminate(self, node: SimNode):
+        meta = self._meta.pop(node, None)
+        if meta is not None:
+            self.terminated.append(meta.type_name)
+        self.remove_node(node, lose_objects=False)
+
+    def _fits_some_type(self, shape: Dict[str, float]) -> bool:
+        return any(
+            all(t.resources.get(k, 0.0) >= v for k, v in shape.items())
+            for t in self.node_types.values())
+
+    def _unmet_shapes(self) -> List[Dict[str, float]]:
+        """Resource shapes with no node that could (eventually) run them."""
+        with self._as_lock:
+            shapes = [s.resources for s in self._pending_specs]
+            for pg in self._pending_pgs:
+                shapes.extend(pg.bundles)
+            shapes.extend(self._requested)
+        with self._lock:
+            alive = [n for n in self.nodes if n.alive]
+        unmet = []
+        capacity = [dict(n.resource_pool.total) for n in alive]
+        for shape in shapes:
+            placed = False
+            for cap in capacity:  # first-fit against existing capacity
+                if all(cap.get(k, 0.0) >= v for k, v in shape.items()):
+                    for k, v in shape.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(dict(shape))
+        return unmet
+
+    def _backlog_pressure(self) -> int:
+        """Queued-beyond-capacity task count across alive nodes."""
+        with self._lock:
+            alive = [n for n in self.nodes if n.alive]
+        pressure = 0
+        for n in alive:
+            cpus = max(int(n.resource_pool.total.get("CPU", 1)), 1)
+            pressure += max(n.scheduler.backlog_size() - cpus, 0)
+        return pressure
+
+    def _bin_pack(self, shapes: List[Dict[str, float]]):
+        """Pick node types covering the shapes (first-fit decreasing by
+        CPU), respecting max_workers."""
+        to_launch: List[NodeTypeConfig] = []
+        headroom: List[Dict[str, float]] = []
+        for shape in sorted(shapes, key=lambda s: -s.get("CPU", 0.0)):
+            placed = False
+            for cap in headroom:
+                if all(cap.get(k, 0.0) >= v for k, v in shape.items()):
+                    for k, v in shape.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in sorted(self.node_types.values(),
+                            key=lambda t: t.resources.get("CPU", 0.0)):
+                if all(t.resources.get(k, 0.0) >= v
+                       for k, v in shape.items()):
+                    planned = (sum(1 for m in self._meta.values()
+                                   if m.type_name == t.name)
+                               + sum(1 for x in to_launch
+                                     if x.name == t.name))
+                    if planned >= t.max_workers:
+                        continue
+                    to_launch.append(t)
+                    cap = dict(t.resources)
+                    for k, v in shape.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    headroom.append(cap)
+                    break
+        return to_launch
+
+    # -------------------------------------------------------------- monitor
+    def _monitor_loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._update()
+            except Exception:  # noqa: BLE001 — monitor must not die
+                pass
+
+    def _update(self):
+        # 1. Scale up for unmet demand.
+        unmet = self._unmet_shapes()
+        if self._backlog_pressure() > 0:
+            with self._lock:
+                alive = [n for n in self.nodes if n.alive]
+            has_free_cpu = any(
+                n.resource_pool.available().get("CPU", 0.0) >= 1.0
+                for n in alive)
+            if not has_free_cpu:
+                # Generic pressure: at most one extra CPU node per tick;
+                # the idle reaper trims any overshoot.
+                unmet.append({"CPU": 1.0})
+        for t in self._bin_pack(unmet):
+            self._launch(t)
+
+        # 2. Retry parked work now that capacity may exist.
+        with self._as_lock:
+            specs, self._pending_specs = self._pending_specs, []
+            pgs, self._pending_pgs = self._pending_pgs, []
+        for spec in specs:
+            self.submit(spec)  # re-parks if still infeasible
+        for pg in pgs:
+            self.reserve_placement_group(pg)
+
+        # 3. Scale down idle nodes past the timeout (never below
+        # min_workers; the head node is not managed).
+        now = time.monotonic()
+        with self._as_lock:
+            requested = list(self._requested)
+        with self._lock:
+            nodes = [n for n in self.nodes if n.alive and n in self._meta]
+        for node in nodes:
+            if any(all(node.resource_pool.total.get(k, 0.0) >= v
+                       for k, v in shape.items()) for shape in requested):
+                continue  # request_resources floor covers this node
+            busy = (node.resource_pool.utilization() > 0
+                    or node.scheduler.backlog_size() > 0)
+            meta = self._meta[node]
+            if busy:
+                meta.idle_since = None
+                continue
+            if meta.idle_since is None:
+                meta.idle_since = now
+                continue
+            if now - meta.idle_since < self.idle_timeout_s:
+                continue
+            t = self.node_types[meta.type_name]
+            count = sum(1 for m in self._meta.values()
+                        if m.type_name == meta.type_name)
+            if count > t.min_workers:
+                self._terminate(node)
+
+    def num_nodes_of_type(self, name: str) -> int:
+        return sum(1 for m in self._meta.values() if m.type_name == name)
+
+    def shutdown(self):
+        self._stop.set()
+        self._monitor.join(timeout=2)
+        super().shutdown()

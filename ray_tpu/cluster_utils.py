@@ -37,7 +37,8 @@ class SimNode:
             worker.store, self.resource_pool,
             num_workers=max(int(resources.get("CPU", 1)), 1),
             task_events=worker.task_events,
-            lineage=cluster.lineage)
+            lineage=cluster.lineage,
+            worker_pool=worker.worker_pool, shm_store=worker.shm_store)
         self.cluster = cluster
 
     def hex(self) -> str:

@@ -1,16 +1,26 @@
 """Actor-plane collective groups (reference API-shape parity:
 init_collective_group / declare / allreduce between actors).
 
-Out-of-program collectives between ray_tpu actors: a named group with
-ranks, a rendezvous barrier, and CPU reductions over numpy arrays. This is
-the control-plane analogue of the reference's Gloo backend — the data plane
-for tensors should use in-program collectives (ray_tpu.collective.ops) which
-ride ICI.
+Out-of-program collectives between ray_tpu actors, store-based: every rank
+posts its contribution to the driver's internal KV (the GCS-KV analogue)
+under a ``(group, round, rank)`` key, polls for the full round, and combines
+locally — the same rendezvous shape as a gloo/TCP-store backend, which is
+what makes it work identically for in-driver (thread) actors and
+process-isolated actors, whose KV calls ride the per-worker API channel.
+This is the control-plane analogue of the reference's Gloo backend — the
+data plane for tensors should use in-program collectives
+(ray_tpu.collective.ops) which ride ICI.
+
+Round keys are garbage-collected with a two-round lag: a rank entering
+round ``r`` has necessarily finished reading round ``r-1``, so each rank
+deletes its own ``r-2`` key on completing ``r`` — no coordination needed.
 """
 
 from __future__ import annotations
 
+import pickle
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -23,63 +33,35 @@ _REDUCERS = {
     "product": lambda arrs: np.prod(arrs, axis=0),
 }
 
-
-class _Group:
-    def __init__(self, world_size: int, name: str):
-        self.world_size = world_size
-        self.name = name
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        self._round = 0
-        self._contrib: Dict[int, Any] = {}
-        self._result: Any = None
-        self._p2p: Dict[tuple, Any] = {}
-        self._p2p_cv = threading.Condition()
-
-    def _collect(self, rank: int, value, combine, timeout: float):
-        """Rendezvous: all ranks contribute, one combines, all read."""
-        with self._cv:
-            my_round = self._round
-            self._contrib[rank] = value
-            if len(self._contrib) == self.world_size:
-                vals = [self._contrib[r] for r in range(self.world_size)]
-                self._result = combine(vals)
-                self._contrib = {}
-                self._round += 1
-                self._cv.notify_all()
-            else:
-                if not self._cv.wait_for(
-                        lambda: self._round > my_round, timeout=timeout):
-                    arrived = len(self._contrib)
-                    # Withdraw this rank's contribution (if the round has
-                    # not advanced) so a later collective on the group
-                    # doesn't complete early with a stale value.
-                    if (self._round == my_round
-                            and self._contrib.get(rank) is value):
-                        del self._contrib[rank]
-                    raise TimeoutError(
-                        f"collective on group {self.name!r}: only "
-                        f"{arrived}/{self.world_size} ranks "
-                        f"arrived within {timeout}s")
-            return self._result
-
-    def send(self, value, src: int, dst: int):
-        with self._p2p_cv:
-            self._p2p[(src, dst)] = value
-            self._p2p_cv.notify_all()
-
-    def recv(self, src: int, dst: int, timeout: float):
-        with self._p2p_cv:
-            if not self._p2p_cv.wait_for(
-                    lambda: (src, dst) in self._p2p, timeout=timeout):
-                raise TimeoutError(f"recv({src}->{dst}) timed out")
-            return self._p2p.pop((src, dst))
-
-
-_groups: Dict[str, _Group] = {}
-_rank_of: Dict[tuple, int] = {}  # (group, thread-key) -> rank
-_lock = threading.Lock()
 _DEFAULT_TIMEOUT = 60.0
+_POLL_S = 0.005
+
+# Per-process state: which rank this thread holds in each group, and the
+# per-(group, rank) round counters. One actor = one thread (or one
+# process), so thread identity disambiguates multiple in-driver actors.
+_rank_of: Dict[tuple, int] = {}  # (group, thread-ident) -> rank
+_seq: Dict[tuple, int] = {}      # (group, rank) -> collective round
+_p2p_send: Dict[tuple, int] = {}  # (group, src, dst) -> send round
+_p2p_recv: Dict[tuple, int] = {}  # (group, src, dst) -> recv round
+_lock = threading.Lock()
+
+
+def _worker():
+    from ray_tpu._private.worker import auto_init
+
+    return auto_init()
+
+
+def _meta_key(group: str) -> bytes:
+    return f"col|{group}|meta".encode()
+
+
+def _round_key(group: str, seq: int, rank: int) -> bytes:
+    return f"col|{group}|r{seq}|{rank}".encode()
+
+
+def _p2p_key(group: str, src: int, dst: int, seq: int) -> bytes:
+    return f"col|{group}|p2p|{src}|{dst}|{seq}".encode()
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -87,16 +69,19 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
     """Join the calling worker to a named group (reference signature
     parity; backend is advisory — 'xla' here, vs 'nccl'/'gloo' there)."""
+    w = _worker()
+    existing = w.kv_get(_meta_key(group_name))
+    if existing is None:
+        w.kv_put(_meta_key(group_name), str(world_size).encode(),
+                 overwrite=False)
+        existing = w.kv_get(_meta_key(group_name))
+    if int(existing) != world_size:
+        raise ValueError(
+            f"group {group_name!r} exists with world_size "
+            f"{int(existing)} != {world_size}")
     with _lock:
-        g = _groups.get(group_name)
-        if g is None:
-            g = _Group(world_size, group_name)
-            _groups[group_name] = g
-        elif g.world_size != world_size:
-            raise ValueError(
-                f"group {group_name!r} exists with world_size "
-                f"{g.world_size} != {world_size}")
-    _set_rank(group_name, rank)
+        _rank_of[(group_name, threading.get_ident())] = rank
+        _seq.setdefault((group_name, rank), 0)
 
 
 def create_collective_group(actors: List[Any], world_size: int,
@@ -122,12 +107,6 @@ def _remote_join(actor, world_size, rank, backend, group_name):
     return actor.collective_join.remote(world_size, rank, backend, group_name)
 
 
-def _set_rank(group_name: str, rank: int):
-    key = (group_name, threading.get_ident())
-    with _lock:
-        _rank_of[key] = rank
-
-
 def _my_rank(group_name: str) -> int:
     key = (group_name, threading.get_ident())
     with _lock:
@@ -138,12 +117,11 @@ def _my_rank(group_name: str) -> int:
         return _rank_of[key]
 
 
-def _group(group_name: str) -> _Group:
-    with _lock:
-        g = _groups.get(group_name)
-    if g is None:
+def _world_size(group_name: str) -> int:
+    raw = _worker().kv_get(_meta_key(group_name))
+    if raw is None:
         raise RuntimeError(f"no collective group {group_name!r}")
-    return g
+    return int(raw)
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -151,69 +129,124 @@ def get_rank(group_name: str = "default") -> int:
 
 
 def get_collective_group_size(group_name: str = "default") -> int:
-    return _group(group_name).world_size
+    return _world_size(group_name)
+
+
+def _collect(group_name: str, value, combine, timeout: float):
+    """Store-based rendezvous: post own contribution, poll for the round,
+    combine locally (deterministic across ranks)."""
+    w = _worker()
+    ws = _world_size(group_name)
+    rank = _my_rank(group_name)
+    with _lock:
+        seq = _seq[(group_name, rank)]
+        _seq[(group_name, rank)] = seq + 1
+    own_key = _round_key(group_name, seq, rank)
+    w.kv_put(own_key, pickle.dumps(value, protocol=5))
+    vals: Dict[int, Any] = {}
+    deadline = time.monotonic() + timeout
+    while True:
+        for r in range(ws):
+            if r not in vals:
+                raw = w.kv_get(_round_key(group_name, seq, r))
+                if raw is not None:
+                    vals[r] = pickle.loads(raw)
+        if len(vals) == ws:
+            break
+        if time.monotonic() > deadline:
+            # Withdraw so a later round can't complete against stale data,
+            # and rewind the round counter for a clean retry.
+            w.kv_del(own_key)
+            with _lock:
+                _seq[(group_name, rank)] = seq
+            raise TimeoutError(
+                f"collective on group {group_name!r}: only "
+                f"{len(vals)}/{ws} ranks arrived within {timeout}s")
+        time.sleep(_POLL_S)
+    if seq >= 2:  # two-round-lag GC of this rank's own old key
+        w.kv_del(_round_key(group_name, seq - 2, rank))
+    return combine([vals[r] for r in range(ws)])
 
 
 def allreduce(tensor, group_name: str = "default", op: str = "sum",
               timeout: float = _DEFAULT_TIMEOUT):
-    g = _group(group_name)
-    arr = np.asarray(tensor)
-    out = g._collect(_my_rank(group_name), arr,
-                     lambda vals: _REDUCERS[op](np.stack(vals)), timeout)
+    out = _collect(group_name, np.asarray(tensor),
+                   lambda vals: _REDUCERS[op](np.stack(vals)), timeout)
     return np.array(out, copy=True)
 
 
 def allgather(tensor, group_name: str = "default",
               timeout: float = _DEFAULT_TIMEOUT):
-    g = _group(group_name)
-    out = g._collect(_my_rank(group_name), np.asarray(tensor),
-                     lambda vals: [np.array(v, copy=True) for v in vals],
-                     timeout)
+    out = _collect(group_name, np.asarray(tensor),
+                   lambda vals: [np.array(v, copy=True) for v in vals],
+                   timeout)
     return list(out)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum",
                   timeout: float = _DEFAULT_TIMEOUT):
-    g = _group(group_name)
+    ws = _world_size(group_name)
     rank = _my_rank(group_name)
     arr = np.asarray(tensor)
-    if arr.shape[0] % g.world_size:
+    if arr.shape[0] % ws:
         raise ValueError(
-            f"leading dim {arr.shape[0]} not divisible by world size "
-            f"{g.world_size}")
-    full = g._collect(rank, arr,
-                      lambda vals: _REDUCERS[op](np.stack(vals)), timeout)
-    chunk = full.shape[0] // g.world_size
+            f"leading dim {arr.shape[0]} not divisible by world size {ws}")
+    full = _collect(group_name, arr,
+                    lambda vals: _REDUCERS[op](np.stack(vals)), timeout)
+    chunk = full.shape[0] // ws
     return np.array(full[rank * chunk:(rank + 1) * chunk], copy=True)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
               timeout: float = _DEFAULT_TIMEOUT):
-    g = _group(group_name)
-    out = g._collect(_my_rank(group_name), np.asarray(tensor),
-                     lambda vals: vals[src_rank], timeout)
+    out = _collect(group_name, np.asarray(tensor),
+                   lambda vals: vals[src_rank], timeout)
     return np.array(out, copy=True)
 
 
 def barrier(group_name: str = "default", timeout: float = _DEFAULT_TIMEOUT):
-    g = _group(group_name)
-    g._collect(_my_rank(group_name), None, lambda vals: None, timeout)
+    _collect(group_name, None, lambda vals: None, timeout)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    g = _group(group_name)
-    g.send(np.array(np.asarray(tensor), copy=True),
-           _my_rank(group_name), dst_rank)
+    w = _worker()
+    src = _my_rank(group_name)
+    with _lock:
+        seq = _p2p_send.get((group_name, src, dst_rank), 0)
+        _p2p_send[(group_name, src, dst_rank)] = seq + 1
+    w.kv_put(_p2p_key(group_name, src, dst_rank, seq),
+             pickle.dumps(np.array(np.asarray(tensor), copy=True),
+                          protocol=5))
 
 
 def recv(src_rank: int, group_name: str = "default",
          timeout: float = _DEFAULT_TIMEOUT):
-    g = _group(group_name)
-    return g.recv(src_rank, _my_rank(group_name), timeout)
+    w = _worker()
+    dst = _my_rank(group_name)
+    with _lock:
+        seq = _p2p_recv.get((group_name, src_rank, dst), 0)
+        _p2p_recv[(group_name, src_rank, dst)] = seq + 1
+    key = _p2p_key(group_name, src_rank, dst, seq)
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = w.kv_get(key)
+        if raw is not None:
+            w.kv_del(key)
+            return pickle.loads(raw)
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv({src_rank}->{dst}) timed out")
+        time.sleep(_POLL_S)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    w = _worker()
+    for key in w.kv_keys(f"col|{group_name}|".encode()):
+        w.kv_del(key)
     with _lock:
-        _groups.pop(group_name, None)
-        for key in [k for k in _rank_of if k[0] == group_name]:
-            _rank_of.pop(key, None)
+        for k in [k for k in _rank_of if k[0] == group_name]:
+            _rank_of.pop(k, None)
+        for k in [k for k in _seq if k[0] == group_name]:
+            _seq.pop(k, None)
+        for d in (_p2p_send, _p2p_recv):
+            for k in [k for k in d if k[0] == group_name]:
+                d.pop(k, None)

@@ -61,7 +61,11 @@ class RayTaskError(RayTpuError):
         if self.cause is None:
             return self
         cause_cls = type(self.cause)
-        if cause_cls is RayTaskError or issubclass(cause_cls, RayTpuError):
+        if isinstance(self.cause, RayTaskError):
+            # Double wrap (a stage re-wrapped an already-typed remote
+            # error): surface the innermost original type.
+            return self.cause.as_instanceof_cause()
+        if issubclass(cause_cls, RayTpuError):
             return self
         try:
             derived = type(

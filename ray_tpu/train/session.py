@@ -1,12 +1,16 @@
 """Per-worker training session (reference role: ray/train/_internal/session).
 
 Thread-local context carrying rank/world_size/dataset shard; ``report()``
-streams metrics (+ optional checkpoint) back to the trainer through a
-result queue.
+streams metrics (+ optional checkpoint) back to the trainer through the
+driver's internal KV under ``(run_id, rank, seq)`` keys — the same
+store-based channel the collective library uses, so it works identically
+for in-driver and process-isolated training workers (whose KV calls ride
+the per-worker API channel).
 """
 
 from __future__ import annotations
 
+import pickle
 import threading
 from typing import Any, Dict, Optional
 
@@ -15,8 +19,12 @@ from ray_tpu.train.checkpoint import Checkpoint
 _local = threading.local()
 
 
+def _report_key(run_id: str, rank: int, seq: int) -> bytes:
+    return f"train|{run_id}|{rank}|{seq}".encode()
+
+
 class TrainContext:
-    def __init__(self, world_rank: int, world_size: int, result_queue,
+    def __init__(self, world_rank: int, world_size: int, run_id: str = "",
                  dataset_shards: Optional[Dict[str, Any]] = None,
                  latest_checkpoint: Optional[Checkpoint] = None,
                  trial_name: str = ""):
@@ -24,7 +32,8 @@ class TrainContext:
         self.world_size = world_size
         self.local_rank = world_rank
         self.trial_name = trial_name
-        self._result_queue = result_queue
+        self.run_id = run_id
+        self._report_seq = 0
         self._dataset_shards = dataset_shards or {}
         self._latest_checkpoint = latest_checkpoint
 
@@ -55,9 +64,14 @@ def get_context() -> TrainContext:
 
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
+    from ray_tpu._private.worker import auto_init
+
     ctx = get_context()
-    ctx._result_queue.put(
-        ("report", ctx.world_rank, dict(metrics), checkpoint))
+    seq = ctx._report_seq
+    ctx._report_seq = seq + 1
+    auto_init().kv_put(
+        _report_key(ctx.run_id, ctx.world_rank, seq),
+        pickle.dumps((dict(metrics), checkpoint), protocol=5))
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -12,7 +12,6 @@ semantics.
 from __future__ import annotations
 
 import os
-import queue
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -89,8 +88,8 @@ class JaxTrainer:
     # -------------------------------------------------------------- attempt
     def _run_attempt(self, restore_from: Optional[Checkpoint]):
         n = self._scaling.total_workers
-        results: "queue.Queue" = queue.Queue()
-        group_name = f"train-{id(self)}-{time.monotonic_ns()}"
+        run_id = f"run-{id(self)}-{time.monotonic_ns()}"
+        group_name = f"train-{run_id}"
 
         # Shard datasets per worker (Dataset.split) once per attempt.
         shards_per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
@@ -112,7 +111,7 @@ class JaxTrainer:
                 collective.init_collective_group(
                     n, rank, group_name=group_name)
                 ctx = TrainContext(
-                    world_rank=rank, world_size=n, result_queue=results,
+                    world_rank=rank, world_size=n, run_id=run_id,
                     dataset_shards=shards_per_worker[rank],
                     latest_checkpoint=restore_from, trial_name=trial_name)
                 _set_context(ctx)
@@ -128,43 +127,59 @@ class JaxTrainer:
         workers = [TrainWorker.remote() for _ in range(n)]
         run_refs = [w.run.remote(i) for i, w in enumerate(workers)]
 
-        # Drain reports while the group runs.
+        # Drain rank-0 reports from the KV channel while the group runs
+        # (reference semantics: the trainer's result stream follows the
+        # rank-0 worker; other ranks' reports are synchronization only).
+        import pickle as _pickle
+
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.train.session import _report_key
+
+        worker = global_worker()
+        next_seq = 0
         history: List[Dict[str, Any]] = []
         latest_metrics: Dict[str, Any] = {}
         latest_ckpt = restore_from
+
+        def _drain():
+            nonlocal next_seq, latest_metrics, latest_ckpt
+            while True:
+                raw = worker.kv_get(_report_key(run_id, 0, next_seq))
+                if raw is None:
+                    return
+                worker.kv_del(_report_key(run_id, 0, next_seq))
+                next_seq += 1
+                metrics, ckpt = _pickle.loads(raw)
+                history.append(metrics)
+                latest_metrics = metrics
+                if ckpt is not None:
+                    latest_ckpt = self._persist(ckpt)
+
         pending = list(run_refs)
         try:
             while pending:
-                try:
-                    kind, rank, metrics, ckpt = results.get(timeout=0.05)
-                    if rank == 0:
-                        history.append(metrics)
-                        latest_metrics = metrics
-                        if ckpt is not None:
-                            latest_ckpt = self._persist(ckpt)
-                    continue
-                except queue.Empty:
-                    pass
+                _drain()
                 done, pending = ray_tpu.wait(
-                    pending, num_returns=len(pending), timeout=0.0)
+                    pending, num_returns=len(pending), timeout=0.05)
                 if done:
                     ray_tpu.get(done)  # surface worker errors
         except Exception as exc:
+            _drain()  # reports that raced with the failure carry the
+            # checkpoint the restart must resume from
             exc._latest_checkpoint = latest_ckpt
             raise
         finally:
-            # Drain any reports that raced with completion.
-            while True:
-                try:
-                    kind, rank, metrics, ckpt = results.get_nowait()
-                    if rank == 0:
-                        history.append(metrics)
-                        latest_metrics = metrics
-                        if ckpt is not None:
-                            latest_ckpt = self._persist(ckpt)
-                except queue.Empty:
-                    break
+            _drain()  # reports that raced with completion
             collective.destroy_collective_group(group_name)
+            for key in worker.kv_keys(f"train|{run_id}|".encode()):
+                worker.kv_del(key)
+            # Release the attempt's worker actors — process-backed actors
+            # each hold an OS process + channel arenas until terminated.
+            for w_handle in workers:
+                try:
+                    ray_tpu.kill(w_handle)
+                except Exception:  # noqa: BLE001
+                    pass
         return latest_metrics, latest_ckpt, history
 
     def _persist(self, ckpt: Checkpoint) -> Checkpoint:

@@ -1,14 +1,19 @@
 """Tuner + trial controller (reference role: ray/tune/tuner.py +
 tune/execution/tune_controller.py trial state machine).
 
-Trials run as actor tasks; the controller drains a shared report queue,
-feeds the scheduler, and delivers stop decisions back to trials through a
-shared stop-set the session checks on every report.
+Trials run as tasks; reports and stop decisions flow through the driver's
+internal KV (the GCS-KV analogue) under ``(run, trial, seq)`` keys — so the
+protocol is identical whether the trial executes in a driver thread or a
+worker process (whose KV calls ride the per-worker API channel). A trial's
+``report()`` blocks until the controller acks the sequence number, keeping
+scheduler decisions synchronous with trial progress — the reference's
+result-processing semantics, and what makes ASHA cuts deterministic rather
+than racing free-running trials.
 """
 
 from __future__ import annotations
 
-import queue
+import pickle
 import threading
 import time
 from dataclasses import dataclass, field
@@ -27,32 +32,65 @@ class _TrialStopped(Exception):
 
 
 class _TuneSession:
-    def __init__(self, trial_id: str, report_queue, stop_set, stop_lock):
+    def __init__(self, run_id: str, trial_id: str):
+        self.run_id = run_id
         self.trial_id = trial_id
-        self.report_queue = report_queue
-        self.stop_set = stop_set
-        self.stop_lock = stop_lock
+        self.seq = 0
+
+
+def _rep_key(run: str, tid: str, seq: int) -> bytes:
+    return f"tune|{run}|rep|{tid}|{seq}".encode()
+
+
+def _ack_key(run: str, tid: str) -> bytes:
+    return f"tune|{run}|ack|{tid}".encode()
+
+
+def _stop_key(run: str, tid: str) -> bytes:
+    return f"tune|{run}|stop|{tid}".encode()
 
 
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
     """Inside a trainable: stream metrics; raises to unwind when the
-    scheduler has stopped this trial.
+    scheduler has stopped this trial. Blocks until the controller acks."""
+    from ray_tpu._private.worker import auto_init
 
-    Blocks until the controller has processed this report (ack event), so
-    scheduler decisions are synchronous with trial progress — the
-    reference's result-processing semantics, and what makes ASHA cuts
-    deterministic rather than racing free-running trial threads.
-    """
     sess = getattr(_local, "tune_session", None)
     if sess is None:
         raise RuntimeError("tune.report() called outside a trial")
-    ack = threading.Event()
-    sess.report_queue.put((sess.trial_id, dict(metrics), checkpoint, ack))
-    ack.wait(timeout=30)
-    with sess.stop_lock:
-        if sess.trial_id in sess.stop_set:
-            raise _TrialStopped()
+    w = auto_init()
+    seq = sess.seq
+    sess.seq = seq + 1
+    w.kv_put(_rep_key(sess.run_id, sess.trial_id, seq),
+             pickle.dumps((dict(metrics), checkpoint), protocol=5))
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        raw = w.kv_get(_ack_key(sess.run_id, sess.trial_id))
+        if raw is not None and int(raw) > seq:
+            break
+        time.sleep(0.005)
+    if w.kv_get(_stop_key(sess.run_id, sess.trial_id)) is not None:
+        raise _TrialStopped()
+
+
+def _trial_main(trainable, run_id: str, trial_id: str,
+                config: Dict[str, Any]) -> str:
+    """Module-level trial body: nested closures would drag module globals
+    (the threading.local) into the cloudpickle payload by value."""
+    _local.tune_session = _TuneSession(run_id, trial_id)
+    try:
+        out = trainable(config)
+        if isinstance(out, dict):
+            try:
+                report(out)
+            except _TrialStopped:
+                pass
+        return "COMPLETED"
+    except _TrialStopped:
+        return "EARLY_STOPPED"
+    finally:
+        _local.tune_session = None
 
 
 @dataclass
@@ -137,25 +175,43 @@ class Tuner:
             for tid, tr in trials.items():
                 scheduler.register(tid, tr.config)
 
-        report_queue: "queue.Queue" = queue.Queue()
-        stop_set: set = set()
-        stop_lock = threading.Lock()
+        run_id = f"tune-{id(self)}-{time.monotonic_ns()}"
         trainable = self._trainable
 
         @ray_tpu.remote
         def run_trial(trial_id, config):
-            _local.tune_session = _TuneSession(
-                trial_id, report_queue, stop_set, stop_lock)
-            try:
-                out = trainable(config)
-                if isinstance(out, dict):
-                    done_ack = threading.Event()
-                    report_queue.put((trial_id, out, None, done_ack))
-                return "COMPLETED"
-            except _TrialStopped:
-                return "EARLY_STOPPED"
-            finally:
-                _local.tune_session = None
+            return _trial_main(trainable, run_id, trial_id, config)
+
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        next_seq: Dict[str, int] = {tid: 0 for tid in trials}
+
+        def _drain():
+            """Consume KV reports in order, feed the scheduler, ack."""
+            progressed = True
+            while progressed:
+                progressed = False
+                for tid in trials:
+                    raw = worker.kv_get(_rep_key(run_id, tid, next_seq[tid]))
+                    if raw is None:
+                        continue
+                    worker.kv_del(_rep_key(run_id, tid, next_seq[tid]))
+                    next_seq[tid] += 1
+                    progressed = True
+                    metrics, ckpt = pickle.loads(raw)
+                    trials[tid].metrics = metrics
+                    trials[tid].metrics_history.append(metrics)
+                    if ckpt is not None:
+                        trials[tid].checkpoint = ckpt
+                    if scheduler.on_result(tid, metrics) == STOP:
+                        worker.kv_put(_stop_key(run_id, tid), b"1")
+                    if hasattr(scheduler, "maybe_exploit"):
+                        new_cfg = scheduler.maybe_exploit(tid)
+                        if new_cfg is not None:
+                            trials[tid].config.update(new_cfg)
+                    worker.kv_put(_ack_key(run_id, tid),
+                                  str(next_seq[tid]).encode())
 
         pending = list(trials.items())
         running: Dict[Any, str] = {}
@@ -165,24 +221,7 @@ class Tuner:
                 tid, trial = pending.pop(0)
                 ref = run_trial.remote(tid, trial.config)
                 running[ref] = tid
-            # Drain reports -> scheduler decisions.
-            try:
-                while True:
-                    tid, metrics, ckpt, ack = report_queue.get_nowait()
-                    trials[tid].metrics = metrics
-                    trials[tid].metrics_history.append(metrics)
-                    if ckpt is not None:
-                        trials[tid].checkpoint = ckpt
-                    if scheduler.on_result(tid, metrics) == STOP:
-                        with stop_lock:
-                            stop_set.add(tid)
-                    if hasattr(scheduler, "maybe_exploit"):
-                        new_cfg = scheduler.maybe_exploit(tid)
-                        if new_cfg is not None:
-                            trials[tid].config.update(new_cfg)
-                    ack.set()
-            except queue.Empty:
-                pass
+            _drain()
             done, _ = ray_tpu.wait(
                 list(running), num_returns=1, timeout=0.05)
             for ref in done:
@@ -192,15 +231,7 @@ class Tuner:
                 except Exception as exc:  # noqa: BLE001 — trial failure
                     trials[tid].error = repr(exc)
                     final_status[tid] = "ERRORED"
-        # Final queue drain.
-        try:
-            while True:
-                tid, metrics, ckpt, ack = report_queue.get_nowait()
-                trials[tid].metrics = metrics
-                trials[tid].metrics_history.append(metrics)
-                if ckpt is not None:
-                    trials[tid].checkpoint = ckpt
-                ack.set()
-        except queue.Empty:
-            pass
+        _drain()  # reports that raced with completion
+        for key in worker.kv_keys(f"tune|{run_id}|".encode()):
+            worker.kv_del(key)
         return ResultGrid(list(trials.values()), tc.metric, tc.mode)

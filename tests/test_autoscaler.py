@@ -1,0 +1,115 @@
+"""Autoscaler tests (reference model: autoscaler unit/e2e tests —
+demand-driven scale-up, idle scale-down, min/max bounds, placement groups).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import AutoscalingCluster, NodeTypeConfig
+from ray_tpu.util import placement_group
+
+
+@pytest.fixture
+def autoscaling_cluster(ray_start_regular):
+    c = AutoscalingCluster(
+        node_types=[
+            NodeTypeConfig("cpu2", {"CPU": 2.0}, min_workers=0,
+                           max_workers=4),
+            NodeTypeConfig("big8", {"CPU": 8.0, "bigmem": 1.0},
+                           min_workers=0, max_workers=2),
+        ],
+        head_resources={"CPU": 1},
+        idle_timeout_s=0.6,
+        update_interval_s=0.05,
+    )
+    yield c
+    c.shutdown()
+
+
+def _wait_for(pred, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg or pred}")
+
+
+def test_scales_up_for_infeasible_task(autoscaling_cluster):
+    c = autoscaling_cluster
+
+    # CPU:2 can't fit on the CPU:1 head — must provision a cpu2 node.
+    @ray_tpu.remote(num_cpus=2)
+    def two():
+        return "ran"
+
+    ref = two.remote()
+    assert ray_tpu.get(ref, timeout=15) == "ran"
+    assert "cpu2" in c.launched
+
+
+def test_scales_up_for_custom_resource(autoscaling_cluster):
+    c = autoscaling_cluster
+
+    @ray_tpu.remote(resources={"bigmem": 1.0})
+    def mem():
+        return "big"
+
+    assert ray_tpu.get(mem.remote(), timeout=15) == "big"
+    assert "big8" in c.launched  # only big8 carries bigmem
+
+
+def test_scales_down_when_idle(autoscaling_cluster):
+    c = autoscaling_cluster
+
+    @ray_tpu.remote(num_cpus=2)
+    def two():
+        return 1
+
+    assert ray_tpu.get(two.remote(), timeout=15) == 1
+    _wait_for(lambda: c.num_nodes_of_type("cpu2") >= 1, msg="scale-up")
+    # Idle past the timeout: reaped back to min_workers=0.
+    _wait_for(lambda: c.num_nodes_of_type("cpu2") == 0, timeout=10,
+              msg="idle scale-down")
+    assert "cpu2" in c.terminated
+
+
+def test_min_workers_maintained():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    c = AutoscalingCluster(
+        node_types=[NodeTypeConfig("cpu2", {"CPU": 2.0}, min_workers=2,
+                                   max_workers=4)],
+        head_resources={"CPU": 1},
+        idle_timeout_s=0.2,
+        update_interval_s=0.05,
+    )
+    try:
+        assert c.num_nodes_of_type("cpu2") == 2
+        time.sleep(1.0)  # idle well past the timeout
+        assert c.num_nodes_of_type("cpu2") == 2  # never below min_workers
+    finally:
+        c.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_max_workers_respected(autoscaling_cluster):
+    c = autoscaling_cluster
+    # Demand for 8 × CPU:2 shapes, but max_workers=4 for cpu2: the packer
+    # may route overflow to big8 (CPU:8) but must not exceed type caps.
+    c.request_resources([{"CPU": 2.0}] * 8)
+    _wait_for(lambda: c.num_nodes_of_type("cpu2") > 0, msg="scale-up")
+    time.sleep(0.5)
+    assert c.num_nodes_of_type("cpu2") <= 4
+    assert c.num_nodes_of_type("big8") <= 2
+
+
+def test_placement_group_triggers_scale_up(autoscaling_cluster):
+    c = autoscaling_cluster
+    pg = placement_group([{"CPU": 2.0}, {"CPU": 2.0}],
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=15)
+    assert c.num_nodes_of_type("cpu2") >= 2 or c.num_nodes_of_type(
+        "big8") >= 1

@@ -89,18 +89,22 @@ def test_error_propagates_through_chain(ray_start_regular):
         ray_tpu.get(passthrough.remote(boom.remote()))
 
 
-def test_retries(ray_start_regular):
-    state = {"n": 0}
+def test_retries(ray_start_regular, tmp_path):
+    # Attempt counting must live OUTSIDE the task: each attempt may run in
+    # a different worker process, so closure state does not carry over.
+    marker = tmp_path / "attempts"
+    marker.write_text("0")
 
     @ray_tpu.remote(max_retries=3, retry_exceptions=True)
     def flaky():
-        state["n"] += 1
-        if state["n"] < 3:
+        n = int(marker.read_text()) + 1
+        marker.write_text(str(n))
+        if n < 3:
             raise RuntimeError("transient")
         return "ok"
 
     assert ray_tpu.get(flaky.remote()) == "ok"
-    assert state["n"] == 3
+    assert int(marker.read_text()) == 3
 
 
 def test_wait(ray_start_regular):

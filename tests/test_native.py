@@ -177,3 +177,19 @@ def test_task_queue_wide_graph_throughput():
     dt = time.perf_counter() - t0
     assert total == n - 1
     assert dt < 2.0  # native propagation is micro-seconds per task
+
+
+def test_arena_reclaims_deleted_objects(store):
+    """Delete must return arena space for reuse (free-list allocator) —
+    a bump-only arena would exhaust under staged-arg churn."""
+    baseline = store.stats()["used"]
+    for i in range(50):
+        store.put(0xBEEF_0000 + i, b"z" * 1_000_000)
+        store.delete(0xBEEF_0000 + i)
+    assert store.stats()["used"] <= baseline + 1024
+    # Differently-sized churn exercises split/coalesce paths.
+    for i in range(50):
+        store.put(0xBEEF_1000 + i, b"z" * (10_000 + 7 * i))
+    for i in range(50):
+        store.delete(0xBEEF_1000 + i)
+    assert store.stats()["used"] <= baseline + 1024

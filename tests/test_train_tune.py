@@ -27,19 +27,27 @@ def _rt(ray_start_regular):
 
 
 def test_trainer_ranks_and_report():
-    seen = []
-    lock = threading.Lock()
+    # Workers run in separate processes: cross-rank evidence must flow
+    # through collectives/reports, not driver-shared lists.
+    from ray_tpu import collective as col
 
     def loop():
         ctx = train.get_context()
-        with lock:
-            seen.append((ctx.get_world_rank(), ctx.get_world_size()))
-        train.report({"rank": ctx.get_world_rank(), "loss": 1.0})
+        col.init_collective_group(4, ctx.get_world_rank(),
+                                  group_name="t_ranks")
+        ranks = col.allgather(np.asarray([ctx.get_world_rank()]),
+                              group_name="t_ranks")
+        if ctx.get_world_rank() == 0:
+            train.report({
+                "ranks": sorted(int(r[0]) for r in ranks),
+                "world_size": ctx.get_world_size(),
+                "loss": 1.0,
+            })
 
     result = JaxTrainer(
         loop, scaling_config=ScalingConfig(num_workers=4)).fit()
-    assert sorted(r for r, _ in seen) == [0, 1, 2, 3]
-    assert all(w == 4 for _, w in seen)
+    assert result.metrics["ranks"] == [0, 1, 2, 3]
+    assert result.metrics["world_size"] == 4
     assert result.metrics["loss"] == 1.0
 
 
@@ -80,20 +88,23 @@ def test_trainer_checkpoint_and_storage(tmp_path):
     assert "ckpt_run" in result.checkpoint.path
 
 
-def test_trainer_failure_restart_from_checkpoint():
-    attempts = []
+def test_trainer_failure_restart_from_checkpoint(tmp_path):
+    # Attempt bookkeeping lives on disk: each attempt may run in a fresh
+    # worker process, so driver-shared lists can't observe it.
+    attempts_file = tmp_path / "attempts"
+    attempts_file.write_text("")
 
     def loop(config):
-        ctx = train.get_context()
         start = 0
         ckpt = train.get_checkpoint()
         if ckpt is not None:
             start = ckpt.to_dict()["step"] + 1
-        attempts.append(start)
+        prior = attempts_file.read_text().splitlines()
+        attempts_file.write_text("\n".join(prior + [str(start)]))
         for step in range(start, 4):
             train.report({"step": step},
                          checkpoint=Checkpoint.from_dict({"step": step}))
-            if step == 1 and len(attempts) == 1:
+            if step == 1 and not prior:
                 raise RuntimeError("injected worker failure")
 
     result = JaxTrainer(
@@ -103,6 +114,7 @@ def test_trainer_failure_restart_from_checkpoint():
     ).fit()
     assert result.metrics["step"] == 3
     # Second attempt resumed past step 0.
+    attempts = [int(x) for x in attempts_file.read_text().splitlines()]
     assert attempts[1] >= 1
 
 
@@ -118,21 +130,22 @@ def test_trainer_failure_exhausted():
 
 def test_trainer_dataset_sharding():
     import ray_tpu.data as rd
-
-    rows_seen = []
-    lock = threading.Lock()
+    from ray_tpu import collective as col
 
     def loop():
+        ctx = train.get_context()
         shard = train.get_dataset_shard("train")
-        n = shard.count()
-        with lock:
-            rows_seen.append(n)
-        train.report({"rows": n})
+        col.init_collective_group(4, ctx.get_world_rank(),
+                                  group_name="t_shard")
+        counts = col.allgather(np.asarray([shard.count()]),
+                               group_name="t_shard")
+        if ctx.get_world_rank() == 0:
+            train.report({"rows": [int(c[0]) for c in counts]})
 
-    JaxTrainer(
+    result = JaxTrainer(
         loop, scaling_config=ScalingConfig(num_workers=4),
         datasets={"train": rd.range(100)}).fit()
-    assert sum(rows_seen) == 100
+    assert sum(result.metrics["rows"]) == 100
 
 
 def test_tune_grid_and_best():
@@ -151,16 +164,11 @@ def test_tune_grid_and_best():
 
 
 def test_tune_asha_stops_bad_trials_early():
-    iters_run = {}
-    lock = threading.Lock()
-
     def trainable(config):
         for i in range(32):
-            with lock:
-                iters_run[config["slope"]] = i + 1
             tune.report({"score": config["slope"] * (i + 1)})
 
-    Tuner(
+    grid = Tuner(
         trainable,
         param_space={"slope": tune.grid_search(
             [50.0, 20.0, 10.0, 0.05, 0.02, 0.01])},
@@ -169,6 +177,8 @@ def test_tune_asha_stops_bad_trials_early():
             scheduler=ASHAScheduler(metric="score", max_t=32,
                                     grace_period=2, reduction_factor=2)),
     ).fit()
+    # Iterations observed per trial = reports the controller consumed.
+    iters_run = {r.config["slope"]: len(r.metrics_history) for r in grid}
     # The weakest configs must have been cut before exhausting max_t.
     assert min(iters_run.values()) < 32
     assert iters_run[50.0] == 32

@@ -1,0 +1,303 @@
+"""Process execution plane tests: PID isolation, crash containment,
+replacement, shm staging, force-cancel, and the driver API service.
+
+Mirrors the reference's worker-crash coverage (SURVEY.md §4: the
+kill-worker/actor failure tests run against real worker processes).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, RayTaskError, \
+    TaskCancelledError
+
+
+@pytest.fixture
+def proc_runtime():
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=2, worker_mode="process",
+                          ignore_reinit_error=True)
+    if worker.worker_pool is None:
+        pytest.skip("native layer unavailable: no process plane")
+    yield worker
+    ray_tpu.shutdown()
+
+
+def test_task_runs_in_separate_pid(proc_runtime):
+    @ray_tpu.remote
+    def pid():
+        return os.getpid()
+
+    worker_pid = ray_tpu.get(pid.remote())
+    assert worker_pid != os.getpid()
+    assert worker_pid in proc_runtime.worker_pool.pids()
+
+
+def test_kill9_fails_task_not_driver(proc_runtime):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(RayTaskError):
+        ray_tpu.get(die.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def ok():
+        return "alive"
+
+    assert ray_tpu.get(ok.remote(), timeout=30) == "alive"
+
+
+def test_crashed_idle_worker_replaced(proc_runtime):
+    pool = proc_runtime.worker_pool
+    victim_pid = pool.pids()[0]
+    os.kill(victim_pid, signal.SIGKILL)
+    time.sleep(0.2)
+
+    @ray_tpu.remote
+    def pid():
+        return os.getpid()
+
+    # All tasks still execute; the dead worker is replaced on lease.
+    pids = ray_tpu.get([pid.remote() for _ in range(4)])
+    assert victim_pid not in pids
+    assert pool.size >= 2
+
+
+def test_oversized_args_ride_shm_store(proc_runtime):
+    import numpy as np
+
+    big = np.arange(1_000_000, dtype=np.float32)  # ~4MB > inline limit
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    # First run may grow the store by a worker's channel arenas (elastic
+    # pool); the steady-state check is run-to-run: staged arg + return
+    # keys must be reclaimed after each reply.
+    assert ray_tpu.get(total.remote(big)) == float(big.sum())
+    time.sleep(0.1)
+    before = proc_runtime.shm_store.stats()["used"]
+    assert ray_tpu.get(total.remote(big)) == float(big.sum())
+    time.sleep(0.1)
+    after = proc_runtime.shm_store.stats()["used"]
+    assert after <= before + 64 * 1024
+
+
+def test_force_cancel_kills_worker(proc_runtime):
+    @ray_tpu.remote
+    def spin():
+        while True:
+            time.sleep(0.1)
+
+    ref = spin.remote()
+    time.sleep(0.5)  # let it land on a worker
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises((TaskCancelledError, RayTaskError)):
+        ray_tpu.get(ref, timeout=30)
+
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    assert ray_tpu.get(ok.remote(), timeout=30) == 1
+
+
+def test_actor_lives_in_own_process(proc_runtime):
+    @ray_tpu.remote
+    class A:
+        def pid(self):
+            return os.getpid()
+
+    a = A.remote()
+    apid = ray_tpu.get(a.pid.remote())
+    assert apid != os.getpid()
+    assert apid not in proc_runtime.worker_pool.pids()  # dedicated process
+
+
+def test_actor_kill9_isolated_and_dead(proc_runtime):
+    @ray_tpu.remote
+    class A:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    a = A.remote()
+    assert ray_tpu.get(a.inc.remote()) == 1
+    os.kill(ray_tpu.get(a.pid.remote()), signal.SIGKILL)
+    time.sleep(0.3)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.inc.remote(), timeout=30)
+
+    # Driver and the task plane survive.
+    @ray_tpu.remote
+    def ok():
+        return "alive"
+
+    assert ray_tpu.get(ok.remote(), timeout=30) == "alive"
+
+
+def test_actor_kill9_restarts_with_fresh_state(proc_runtime):
+    @ray_tpu.remote(max_restarts=1)
+    class A:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    a = A.remote()
+    assert ray_tpu.get(a.inc.remote()) == 1
+    old_pid = ray_tpu.get(a.pid.remote())
+    os.kill(old_pid, signal.SIGKILL)
+    time.sleep(0.3)
+    # The first call after the crash consumes the restart (it may fail as
+    # the crash casualty); fresh state must follow.
+    try:
+        ray_tpu.get(a.inc.remote(), timeout=30)
+    except ActorDiedError:
+        pass
+    assert ray_tpu.get(a.inc.remote(), timeout=30) == 1
+    assert ray_tpu.get(a.pid.remote()) != old_pid
+
+
+def test_nested_task_submission_inside_worker(proc_runtime):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(add.remote(20, 22))
+
+    assert ray_tpu.get(outer.remote(), timeout=60) == 42
+
+
+def test_actor_handle_passed_into_process_task(proc_runtime):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def use(counter):
+        return ray_tpu.get(counter.inc.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(use.remote(c), timeout=60) == 1
+    assert ray_tpu.get(c.inc.remote()) == 2
+
+
+def test_put_get_wait_inside_worker(proc_runtime):
+    @ray_tpu.remote
+    def roundtrip():
+        ref = ray_tpu.put({"k": [1, 2, 3]})
+        ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=10)
+        assert not not_ready
+        return ray_tpu.get(ready[0])
+
+    assert ray_tpu.get(roundtrip.remote(), timeout=60) == {"k": [1, 2, 3]}
+
+
+def test_runtime_context_inside_worker(proc_runtime):
+    @ray_tpu.remote
+    def ctx():
+        rc = ray_tpu.get_runtime_context()
+        return rc.get_task_id(), rc.get_node_id(), rc.get_job_id()
+
+    task_id, node_id, job_id = ray_tpu.get(ctx.remote(), timeout=60)
+    assert task_id is not None
+    assert node_id == proc_runtime.node_id.hex()
+    assert job_id == proc_runtime.job_id.hex()
+
+
+def test_actor_created_from_inside_task(proc_runtime):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def read(self):
+            return self.n
+
+    @ray_tpu.remote
+    def make():
+        c = Counter.remote(start=7)
+        return ray_tpu.get(c.read.remote())
+
+    assert ray_tpu.get(make.remote(), timeout=60) == 7
+
+
+def test_thread_mode_still_works():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread", ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    def pid():
+        return os.getpid()
+
+    assert ray_tpu.get(pid.remote()) == os.getpid()
+    ray_tpu.shutdown()
+
+
+def test_large_payload_api_roundtrip(proc_runtime):
+    """>1MB values must ride the store, not the 1MB API channel, in BOTH
+    directions (request blob staging + whole-reply staging)."""
+    import numpy as np
+
+    big = np.random.rand(600_000)  # ~4.8MB pickled
+
+    @ray_tpu.remote
+    def roundtrip(x):
+        ref = ray_tpu.put(x * 2)        # big put from inside the worker
+        return float(ray_tpu.get(ref).sum())  # big get back into the worker
+
+    assert abs(ray_tpu.get(roundtrip.remote(big), timeout=60)
+               - float((big * 2).sum())) < 1e-6
+
+
+def test_large_collective_between_process_actors(proc_runtime):
+    import numpy as np
+    from ray_tpu import collective as col
+
+    @ray_tpu.remote
+    class W:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def collective_join(self, world_size, rank, backend, group):
+            col.init_collective_group(world_size, rank, backend, group)
+            return rank
+
+        def reduce(self, group):
+            # ~2.4MB contribution: rides the api_blob path through the KV.
+            out = col.allreduce(np.full((300_000,), float(self.rank + 1)),
+                                group_name=group)
+            return float(out.sum())
+
+    workers = [W.remote(i) for i in range(2)]
+    col.create_collective_group(workers, world_size=2, ranks=[0, 1],
+                                group_name="gbig")
+    outs = ray_tpu.get([w.reduce.remote("gbig") for w in workers],
+                       timeout=60)
+    assert outs == [300_000.0 * 3, 300_000.0 * 3]
+    col.destroy_collective_group("gbig")
