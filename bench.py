@@ -320,6 +320,96 @@ def bench_model_train_step(repeats=5, inner=10):
         return {"suite": "model_train_step", "skipped": repr(e)}
 
 
+_SHARDED_SCRIPT = r"""
+import json, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+@ray_tpu.remote
+def scale(x):
+    return x * 1.001 + 0.5
+
+@ray_tpu.remote
+def merge(a, b):
+    return a + b
+
+with InputNode() as inp:
+    chains = []
+    for _ in range(64):
+        node = inp
+        for _ in range(15):
+            node = scale.bind(node)
+        chains.append(node)
+    while len(chains) > 1:
+        chains = [merge.bind(chains[i], chains[i + 1])
+                  for i in range(0, len(chains), 2)]
+    dag = chains[0]
+
+mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("dag",))
+single = dag.experimental_compile(backend="jax", payload_shape=(1024,))
+sharded = dag.experimental_compile(
+    backend="jax", payload_shape=(1024,), mesh=mesh, mesh_axis="dag")
+x = np.linspace(0.0, 1.0, 1024, dtype=np.float32)
+np.testing.assert_allclose(sharded.execute(x).get(),
+                           single.execute(x).get(), rtol=1e-5)
+
+def timeit(c, n=20):
+    c.execute(x).get()
+    t0 = time.perf_counter()
+    ref = None
+    for _ in range(n):
+        ref = c.execute(x)
+    jax.block_until_ready(ref.device_value())
+    return (time.perf_counter() - t0) / n
+
+print(json.dumps({
+    "suite": "sharded_dag_1k_tensor",
+    "num_tasks": 64 * 15 + 63,
+    "payload": [1024],
+    "num_shards": 8,
+    "export_width": sharded.export_width,
+    "lanes_per_shard": sharded.lanes_per_shard,
+    "exchange_fraction": (sharded.export_width
+                          / max(sharded.lanes_per_shard, 1)),
+    "single_dev_wall_s": timeit(single),
+    "sharded_wall_s": timeit(sharded),
+    "note": "8 virtual CPU devices (no multi-chip hardware); "
+            "exchange_fraction is the compile-time ICI volume vs the "
+            "whole-wave all_gather a replicated exchange would ship",
+}))
+"""
+
+
+def bench_sharded():
+    """Config #7: mesh-sharded compiled DAG on the virtual 8-device CPU
+    mesh — parity + compile-time exchange volume (SURVEY.md §2.3 north
+    star; real-ICI numbers need multi-chip hardware)."""
+    import json as _json
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_PLATFORM"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _SHARDED_SCRIPT], env=env,
+            capture_output=True, text=True, timeout=420)
+        line = out.stdout.strip().splitlines()[-1]
+        return _json.loads(line)
+    except Exception as e:  # noqa: BLE001 — suite optional
+        return {"suite": "sharded_dag_1k_tensor", "skipped": repr(e)}
+
+
 def bench_rl_rollout():
     """Config #5: PPO rollout collection, CartPole, 64 vectorized envs."""
     try:
@@ -335,7 +425,8 @@ def main():
     parser.add_argument("--all", action="store_true",
                         help="run every suite, print per-suite results")
     parser.add_argument("--suite", choices=[
-        "chain", "fanout", "actor", "data", "rl", "model"], default=None)
+        "chain", "fanout", "actor", "data", "rl", "model", "sharded"],
+        default=None)
     parser.add_argument("--iters", type=int, default=10)
     args = parser.parse_args()
 
@@ -346,6 +437,7 @@ def main():
         "data": bench_data_map_batches,
         "rl": bench_rl_rollout,
         "model": bench_model_train_step,
+        "sharded": bench_sharded,
     }
 
     if args.suite:
@@ -359,7 +451,7 @@ def main():
     # driver's single-line artifact carries every suite, with medians and
     # spreads, not just the headline.
     breakdown = {"chain": chain, "fanout": fanout}
-    for name in ("actor", "data", "rl", "model"):
+    for name in ("actor", "data", "rl", "model", "sharded"):
         try:
             breakdown[name] = suites[name]()
         except Exception as e:  # noqa: BLE001 — suite failure is data too

@@ -157,12 +157,14 @@ void arena_free(Store* s, uint64_t off, uint64_t size) {
       need += f->size;
       h->free_bytes -= f->size;
       *f = s->freelist[--h->free_count];
+      i = 0;  // the grown block may now touch an already-scanned entry
       continue;
     }
     if (off + need == f->offset) {
       need += f->size;
       h->free_bytes -= f->size;
       *f = s->freelist[--h->free_count];
+      i = 0;
       continue;
     }
     i++;

@@ -21,7 +21,8 @@ import pickle
 import threading
 from typing import Any, List, Optional
 
-_INLINE_LIMIT = 1 << 20  # replies bigger than this ride the shm store
+# Replies bigger than this ride the shm store (service_loop enforces it
+# uniformly for every reply kind; headroom under the 1MB channels).
 
 # Driver-side stage keys for oversized replies (distinct from the
 # 0xA4A0… task-arg range and the 0xA4B0… client range).
@@ -35,13 +36,6 @@ def _next_reply_key() -> int:
         return 0xA4C0_0000_0000_0000 | (_reply_counter[0] & 0xFFFF_FFFF_FFFF)
 
 
-def _pack_reply(shm_store, value_bytes: bytes):
-    """("ok", bytes) inline, or ("okshm", key) through the store."""
-    if shm_store is not None and len(value_bytes) > _INLINE_LIMIT:
-        key = _next_reply_key()
-        shm_store.put(key, value_bytes)
-        return ("okshm", key)
-    return ("ok", value_bytes)
 
 
 class _ServiceState:
@@ -88,7 +82,7 @@ def handle_request(worker, shm_store, state: _ServiceState, msg: tuple):
     if kind == "api_get":
         _, oid_bin, timeout = msg
         serialized = worker.store.get(ObjectID(oid_bin), timeout=timeout)
-        return _pack_reply(shm_store, serialized.to_bytes())
+        return ("ok", serialized.to_bytes())
     if kind == "api_wait":
         _, oid_bins, num_returns, timeout = msg
         ready, not_ready = worker.store.wait(
@@ -188,9 +182,10 @@ def service_loop(proc) -> None:
                 reply = ("err", pickle.dumps(
                     RuntimeError(f"{type(exc).__name__}: {exc}")))
         try:
-            if len(pickle.dumps(reply, protocol=5)) > inline_limit:
+            raw = pickle.dumps(reply, protocol=5)  # dumped once, reused
+            if len(raw) > inline_limit:
                 key = _next_reply_key()
-                proc._store.put(key, pickle.dumps(reply, protocol=5))
+                proc._store.put(key, raw)
                 reply = ("okshm_reply", key)
         except Exception:  # noqa: BLE001 — unpicklable reply stays inline
             pass

@@ -1,0 +1,112 @@
+"""Worker log aggregation: capture + stream worker-process output.
+
+Rebuild of the reference's log plane (reference roles:
+python/ray/_private/log_monitor.py tailing per-worker log files, and the
+driver-side printer that prefixes lines with the producing worker
+[unverified]). Worker processes write stdout/stderr to per-worker files
+under ``<session_dir>/logs``; one driver-side monitor thread tails the
+directory and re-emits new lines to the driver's stderr as
+``(worker pid=N) line`` — so a ``print()`` inside any task or actor shows
+up at the driver, like the reference's worker-log streaming.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, TextIO
+
+
+class LogMonitor:
+    """Tail every ``*.out``/``*.err`` file in a directory, streaming new
+    lines (prefixed with the producing worker's identity) to a sink."""
+
+    def __init__(self, log_dir: str, sink: TextIO = None,
+                 poll_s: float = 0.15):
+        self.log_dir = log_dir
+        self._sink = sink
+        self._poll_s = poll_s
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ray_tpu_log_monitor")
+        self._thread.start()
+
+    def _emit(self, fname: str, line: str):
+        # worker-<id>-<pid>.out -> "(worker <id> pid=<pid>)" prefix.
+        base = fname.rsplit(".", 1)[0]
+        parts = base.split("-")
+        tag = base
+        if len(parts) >= 3 and parts[0] == "worker":
+            tag = f"worker={parts[1]} pid={parts[2]}"
+        sink = self._sink if self._sink is not None else sys.stderr
+        try:
+            sink.write(f"({tag}) {line}\n")
+            sink.flush()
+        except Exception:  # noqa: BLE001 — sink gone at teardown
+            pass
+
+    def poll_once(self):
+        """One tail pass (also used directly by tests)."""
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except OSError:
+            return
+        for fname in names:
+            if not (fname.endswith(".out") or fname.endswith(".err")):
+                continue
+            path = os.path.join(self.log_dir, fname)
+            try:
+                size = os.path.getsize(path)
+                offset = self._offsets.get(fname, 0)
+                if size <= offset:
+                    continue
+                with open(path, "r", errors="replace") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+                # Only complete lines; partial tails re-read next pass.
+                end = chunk.rfind("\n")
+                if end < 0:
+                    continue
+                self._offsets[fname] = offset + len(
+                    chunk[:end + 1].encode("utf-8", errors="replace"))
+                for line in chunk[:end].splitlines():
+                    if line:
+                        self._emit(fname, line)
+            except OSError:
+                continue
+
+    def _loop(self):
+        while not self._stop.wait(self._poll_s):
+            self.poll_once()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+        self.poll_once()  # final drain
+
+
+def list_log_files(log_dir: str):
+    try:
+        return sorted(
+            f for f in os.listdir(log_dir)
+            if f.endswith(".out") or f.endswith(".err"))
+    except OSError:
+        return []
+
+
+def latest_session_dir(base: str = None) -> str:
+    """The most recent session directory (the `logs` CLI entry point)."""
+    import tempfile
+
+    base = base or os.path.join(tempfile.gettempdir(), "ray_tpu")
+    link = os.path.join(base, "session_latest")
+    if os.path.islink(link) or os.path.isdir(link):
+        return os.path.realpath(link)
+    sessions = sorted(
+        (d for d in os.listdir(base) if d.startswith("session_")),
+        key=lambda d: os.path.getmtime(os.path.join(base, d)))
+    if not sessions:
+        raise FileNotFoundError(f"no ray_tpu sessions under {base}")
+    return os.path.join(base, sessions[-1])

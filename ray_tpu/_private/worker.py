@@ -138,6 +138,15 @@ class Worker:
             tempfile.gettempdir(), "ray_tpu",
             f"session_{uuid.uuid4().hex[:12]}")
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # session_latest convenience link (the `logs` CLI default target).
+        link = os.path.join(os.path.dirname(self.session_dir),
+                            "session_latest")
+        try:
+            if os.path.islink(link):
+                os.unlink(link)
+            os.symlink(self.session_dir, link)
+        except OSError:
+            pass
         self.serialization_context = SerializationContext()
         spill_dir = GlobalConfig.object_spill_dir or os.path.join(
             self.session_dir, "spill")
@@ -173,10 +182,28 @@ class Worker:
                 self.shm_store = NativeObjectStore.create(
                     capacity=GlobalConfig.shm_store_bytes,
                     max_objects=GlobalConfig.shm_store_slots)
+                log_dir = os.path.join(self.session_dir, "logs")
                 self.worker_pool = WorkerPool(
                     self.shm_store, num_workers=max(int(num_cpus), 1),
-                    max_msg=GlobalConfig.worker_channel_bytes)
+                    max_msg=GlobalConfig.worker_channel_bytes,
+                    log_dir=log_dir)
+                # Stream worker prints back to the driver (log plane).
+                from ray_tpu._private.log_monitor import LogMonitor
+
+                self.log_monitor = LogMonitor(log_dir)
             except Exception:  # noqa: BLE001 — no native toolchain: degrade
+                # Release anything half-built: a created shm segment and
+                # spawned worker processes must not outlive the fallback.
+                if self.worker_pool is not None:
+                    try:
+                        self.worker_pool.shutdown()
+                    except Exception:  # noqa: BLE001
+                        pass
+                if self.shm_store is not None:
+                    try:
+                        self.shm_store.close()
+                    except Exception:  # noqa: BLE001
+                        pass
                 self.worker_mode = "thread"
                 self.shm_store = None
                 self.worker_pool = None
@@ -299,6 +326,9 @@ class Worker:
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
             self.worker_pool = None
+        if getattr(self, "log_monitor", None) is not None:
+            self.log_monitor.stop()
+            self.log_monitor = None
         if self.shm_store is not None:
             self.shm_store.close()
             self.shm_store = None

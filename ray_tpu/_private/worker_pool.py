@@ -34,6 +34,27 @@ from ray_tpu.exceptions import (
 _INLINE_LIMIT = 256 * 1024  # args bigger than this ride the shm store
 
 
+def _pump_stream(stream, path: str):
+    """Copy one worker pipe into its session log file, line-buffered."""
+    try:
+        with open(path, "ab", buffering=0) as f:
+            for chunk in iter(lambda: stream.readline(), b""):
+                f.write(chunk)
+    except Exception:  # noqa: BLE001 — worker died mid-write
+        pass
+
+
+def _try_owner_log_dir():
+    """The driver session's log dir, if the runtime is up (workers spawned
+    during Worker.__init__ resolve it via the config fallback)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod._try_global_worker()
+    if w is not None and getattr(w, "session_dir", None):
+        return os.path.join(w.session_dir, "logs")
+    return os.environ.get("RAY_TPU_SESSION_LOG_DIR")
+
+
 class WorkerProcess:
     """One spawned worker + its request/reply channels."""
 
@@ -41,7 +62,8 @@ class WorkerProcess:
     _id_lock = threading.Lock()
 
     def __init__(self, store, max_msg: int = 4 << 20,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None):
         from ray_tpu._native.store import NativeMutableChannel
 
         with WorkerProcess._id_lock:
@@ -93,7 +115,32 @@ class WorkerProcess:
         prev = full_env.get("PYTHONPATH", "")
         full_env["PYTHONPATH"] = os.pathsep.join(
             extra_path + ([prev] if prev else []))
-        self.proc = subprocess.Popen(cmd, env=full_env)
+        # Log plane: worker stdout/stderr land in per-worker session files
+        # that the driver's LogMonitor tails back to the driver's stderr.
+        self._log_files = []
+        stdout = stderr = None
+        if log_dir is None:
+            owner = _try_owner_log_dir()
+            log_dir = owner
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self.proc = subprocess.Popen(cmd, env=full_env,
+                                         stdout=subprocess.PIPE,
+                                         stderr=subprocess.PIPE)
+            # Re-open by pid AFTER spawn so the filename carries the real
+            # worker pid; cheap copy threads drain the pipes into files.
+            for stream, ext in ((self.proc.stdout, "out"),
+                                (self.proc.stderr, "err")):
+                path = os.path.join(
+                    log_dir, f"worker-{self.worker_id}-{self.proc.pid}.{ext}")
+                t = threading.Thread(
+                    target=_pump_stream, args=(stream, path), daemon=True,
+                    name=f"ray_tpu_logpump_{self.worker_id}_{ext}")
+                t.start()
+                self._log_files.append(path)
+        else:
+            self.proc = subprocess.Popen(cmd, env=full_env,
+                                         stdout=stdout, stderr=stderr)
         self._dead = False
         self._svc_stop = False
         from ray_tpu._private.driver_service import service_loop
@@ -175,19 +222,22 @@ class WorkerPool:
     """Prestarted worker processes with lease/return + crash replacement."""
 
     def __init__(self, store, num_workers: int, max_msg: int = 4 << 20,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 log_dir: Optional[str] = None):
         self._store = store
         self._max_msg = max_msg
+        self._log_dir = log_dir
         self._lock = threading.Lock()
         self._idle: "queue.Queue[WorkerProcess]" = queue.Queue()
         self._all: List[WorkerProcess] = []
         self._shutdown = False
+        self._spawning = 0  # growth slots reserved but not yet spawned
         # Elastic cap: blocked workers (nested get() inside a task) hold
         # their lease, so the pool grows past the base size rather than
         # deadlocking — the reference's dynamic worker-start behavior.
         self._max_workers = max_workers or max(num_workers * 4, num_workers)
         for _ in range(num_workers):
-            w = WorkerProcess(store, max_msg=max_msg)
+            w = WorkerProcess(store, max_msg=max_msg, log_dir=log_dir)
             self._all.append(w)
             self._idle.put(w)
 
@@ -200,24 +250,30 @@ class WorkerPool:
                 w = self._idle.get(timeout=0.5)
             except queue.Empty:
                 with self._lock:
+                    # Reserve the growth slot under the lock so concurrent
+                    # leasers can't collectively overshoot max_workers.
                     can_grow = (not self._shutdown
-                                and len(self._all) < self._max_workers)
+                                and (len(self._all) + self._spawning
+                                     < self._max_workers))
+                    if can_grow:
+                        self._spawning += 1
                 if can_grow:
                     try:
                         # Spawn OUTSIDE the lock (process startup must not
                         # stall concurrent leases) and degrade to waiting
                         # if the shm store can't fit more channel arenas.
                         fresh = WorkerProcess(self._store,
-                                              max_msg=self._max_msg)
+                                              max_msg=self._max_msg,
+                                              log_dir=self._log_dir)
                     except Exception:  # noqa: BLE001 — e.g. store full
                         fresh = None
-                    if fresh is not None:
-                        with self._lock:
-                            if self._shutdown:
-                                fresh.shutdown(timeout=0.1)
-                            else:
-                                self._all.append(fresh)
-                                return fresh
+                    with self._lock:
+                        self._spawning -= 1
+                        if fresh is not None and not self._shutdown:
+                            self._all.append(fresh)
+                            return fresh
+                    if fresh is not None:  # raced shutdown
+                        fresh.shutdown(timeout=0.1)
                 if _time.monotonic() >= deadline:
                     raise WorkerPoolExhaustedError(
                         f"no idle worker within {timeout:.0f}s "
@@ -246,7 +302,8 @@ class WorkerPool:
             except ValueError:
                 pass
             dead.shutdown(timeout=0.1)
-            fresh = WorkerProcess(self._store, max_msg=self._max_msg)
+            fresh = WorkerProcess(self._store, max_msg=self._max_msg,
+                                  log_dir=self._log_dir)
             self._all.append(fresh)
             self._idle.put(fresh)
 

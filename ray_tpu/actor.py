@@ -199,9 +199,13 @@ class _ActorRuntime:
             pack_args,
         )
 
+        import os
+
         worker = global_worker()
         proc = WorkerProcess(worker.shm_store,
-                             max_msg=GlobalConfig.worker_channel_bytes)
+                             max_msg=GlobalConfig.worker_channel_bytes,
+                             log_dir=os.path.join(worker.session_dir,
+                                                  "logs"))
         staged = []
         try:
             args, kwargs = _resolve_values(

@@ -234,6 +234,10 @@ def recv(src_rank: int, group_name: str = "default",
             w.kv_del(key)
             return pickle.loads(raw)
         if time.monotonic() > deadline:
+            # Rewind so a retry (or the late-arriving message) still lines
+            # up with this sequence number instead of skipping it forever.
+            with _lock:
+                _p2p_recv[(group_name, src_rank, dst)] = seq
             raise TimeoutError(f"recv({src_rank}->{dst}) timed out")
         time.sleep(_POLL_S)
 

@@ -23,15 +23,21 @@ whole DAG into a single XLA program:
   in-degrees with a segment-sum over the edge list — ObjectRef dependency
   resolution as sparse ops, no host round-trips per wave.
 
-Multi-chip (``mesh=``): task waves are partitioned over a Mesh axis with
-``shard_map`` — each shard executes its slice of every wave against its own
-copy of the object table, and the wave's outputs are exchanged with a single
-``lax.all_gather`` riding ICI, which is how cross-shard dependency edges
-lower to collectives. The schedule split is static (lane ``j`` of a wave
-runs on shard ``j // (W/n)``), so a fan-out of 10k tasks runs 10k/n per
-chip and the only per-wave communication is one collective over the wave's
-output payloads. The dynamic frontier mode shards the masked task list the
-same way (task ``ci`` owned by shard ``ci // (C/n)``), with the in-degree
+Multi-chip (``mesh=``): the task schedule is partitioned over a Mesh axis
+with ``shard_map``; the object table is PARTIALLY replicated — every shard
+holds the full-slot buffer in HBM but only its own lanes' outputs and its
+imports are ever written/read there (unconsumed remote slots stay stale
+zeros). Lane assignment is locality-aware (a task lands on the shard that
+produced most of its inputs, balanced to W/n lanes per shard per wave),
+and the per-wave exchange ships ONLY cross-shard-consumed outputs — packed
+to the compile-time max export count and moved with one tiled
+``lax.all_gather`` over ICI. Chain-heavy graphs therefore export nothing
+and compile with zero collectives; a fully-connected fan-in degenerates to
+a whole-wave gather. The HBM cost of replicating the table
+(``num_slots × payload``) is the deliberate trade for static single-pass
+scatters; the ICI cost is proportional to actual cross-shard edges, not
+wave width. The dynamic frontier mode still gathers the whole masked
+frontier (its ready set is unknown at compile time), with the in-degree
 vector and done mask kept replicated.
 """
 
@@ -468,38 +474,113 @@ def compile_jax_dag(
 
         else:
             # ---- mesh-sharded static waves ----------------------------------
-            # Pad wave width to a multiple of n_sh; shard j owns lanes
-            # [j*Wn, (j+1)*Wn) of every wave. Output slots per lane are
-            # static, so after the per-wave all_gather every shard applies
-            # the identical scatter to its table copy.
+            # The schedule is sharded; the object table is PARTIALLY
+            # replicated: every shard holds the full [num_slots] buffer in
+            # HBM, but only writes (a) its own lanes' outputs and (b) slots
+            # it imports from other shards — slots neither produced nor
+            # consumed by a shard hold stale zeros there and are never
+            # read. Lane assignment is locality-aware (a task prefers the
+            # shard that produced most of its inputs), and the per-wave
+            # exchange ships ONLY cross-shard-consumed outputs, packed to
+            # the max export count X_max, through one tiled all_gather —
+            # not the whole wave. Chain-heavy graphs export nothing and
+            # skip the collective entirely; an all-to-all fan-in
+            # degenerates to the old whole-wave gather.
             from jax.sharding import PartitionSpec as P
 
             Wn = -(-wave_width // n_sh)
-            W_pad = Wn * n_sh
-            sched_pad = np.full((num_waves, W_pad), -1, np.int32)
-            sched_pad[:, :wave_width] = sched
-            wave_slots = np.full((num_waves, W_pad), scratch_slot, np.int32)
-            for wi in range(num_waves):
-                for j in range(W_pad):
-                    ci = sched_pad[wi, j]
-                    if ci >= 0:
-                        wave_slots[wi, j] = out_slots[ci]
-            sched_sharded = jnp.asarray(
-                sched_pad.reshape(num_waves, n_sh, Wn))
-            wave_slots_dev = jnp.asarray(wave_slots)
-            wave_width = W_pad
+            waves_list = waves  # [wave] -> [ci...]
 
-            def _sharded_static(inputs, sched_local):
-                sched_local = sched_local[:, 0]          # [num_waves, Wn]
+            # Locality-aware lane assignment: balance Wn lanes per shard
+            # per wave, preferring the shard owning most producers.
+            owner = np.zeros(C, np.int32)
+            for wi, w in enumerate(waves_list):
+                counts = [0] * n_sh
+                for ci in w:
+                    prefs: Dict[int, int] = {}
+                    for s in fused[ci][1]:
+                        p = compact_producer.get(int(s))
+                        if p is not None:
+                            sh = int(owner[p])
+                            prefs[sh] = prefs.get(sh, 0) + 1
+                    cand = sorted(
+                        range(n_sh),
+                        key=lambda sh: (-prefs.get(sh, 0), counts[sh]))
+                    sh = next(s for s in cand if counts[s] < Wn)
+                    owner[ci] = sh
+                    counts[sh] += 1
+
+            # Which shards consume each slot (leaf slots: all shards, so
+            # the out_specs-P() output is genuinely replicated).
+            consumers_of_slot: Dict[int, set] = {}
+            for ci, (_, deps, _, _, _) in enumerate(fused):
+                for s in deps:
+                    consumers_of_slot.setdefault(int(s), set()).add(
+                        int(owner[ci]))
+            for s in leaf_slots.tolist():
+                consumers_of_slot.setdefault(int(s), set()).update(
+                    range(n_sh))
+
+            # Per-(wave, shard) lane tables + export sets.
+            sched_sh = np.full((n_sh, num_waves, Wn), -1, np.int32)
+            lane_of: Dict[int, Tuple[int, int]] = {}  # ci -> (shard, lane)
+            for wi, w in enumerate(waves_list):
+                fill = [0] * n_sh
+                for ci in w:
+                    sh = int(owner[ci])
+                    sched_sh[sh, wi, fill[sh]] = ci
+                    lane_of[ci] = (sh, fill[sh])
+                    fill[sh] += 1
+            exports: List[List[List[int]]] = [
+                [[] for _ in range(num_waves)] for _ in range(n_sh)]
+            for wi, w in enumerate(waves_list):
+                for ci in w:
+                    sh = int(owner[ci])
+                    slot = int(out_slots[ci])
+                    if consumers_of_slot.get(slot, set()) - {sh}:
+                        exports[sh][wi].append(ci)
+            X_max = max(
+                (len(exports[sh][wi]) for sh in range(n_sh)
+                 for wi in range(num_waves)), default=0)
+
+            own_slots_sh = np.full((n_sh, num_waves, Wn), scratch_slot,
+                                   np.int32)
+            for ci, (sh, lane) in lane_of.items():
+                lvl = int(levels[ci])
+                own_slots_sh[sh, lvl, lane] = out_slots[ci]
+            exp_idx_sh = np.zeros((n_sh, num_waves, max(X_max, 1)),
+                                  np.int32)
+            exp_slots = np.full((num_waves, n_sh * max(X_max, 1)),
+                                scratch_slot, np.int32)
+            for sh in range(n_sh):
+                for wi in range(num_waves):
+                    for k, ci in enumerate(exports[sh][wi]):
+                        exp_idx_sh[sh, wi, k] = lane_of[ci][1]
+                        exp_slots[wi, sh * max(X_max, 1) + k] = out_slots[ci]
+
+            sched_dev_sh = jnp.asarray(sched_sh)
+            own_dev_sh = jnp.asarray(own_slots_sh)
+            exp_idx_dev_sh = jnp.asarray(exp_idx_sh)
+            exp_slots_dev = jnp.asarray(exp_slots)
+            wave_width = Wn * n_sh
+
+            def _sharded_static(inputs, sched_local, own_local, expi_local):
+                sched_l = sched_local[0]                 # [num_waves, Wn]
+                own_l = own_local[0]
+                expi_l = expi_local[0]
                 obj = jnp.zeros((num_slots,) + payload_shape, dtype)
                 if num_inputs:
                     obj = obj.at[:num_inputs].set(inputs)
 
                 def wave(w, o):
-                    outs = _compute_tasks(o, sched_local[w])   # [Wn, *P]
-                    gathered = lax.all_gather(
-                        outs, mesh_axis, axis=0, tiled=True)   # [W_pad, *P]
-                    return o.at[wave_slots_dev[w]].set(gathered)
+                    outs = _compute_tasks(o, sched_l[w])       # [Wn, *P]
+                    o = o.at[own_l[w]].set(outs)               # own outputs
+                    if X_max > 0:
+                        exp = outs[expi_l[w]]                  # [X_max, *P]
+                        gathered = lax.all_gather(
+                            exp, mesh_axis, axis=0, tiled=True)
+                        o = o.at[exp_slots_dev[w]].set(gathered)
+                    return o
 
                 if num_waves == 1:
                     obj = wave(0, obj)
@@ -510,11 +591,15 @@ def compile_jax_dag(
 
             sharded_fn = jax.jit(jax.shard_map(
                 _sharded_static, mesh=mesh,
-                in_specs=(P(), P(None, mesh_axis, None)),
+                in_specs=(P(), P(mesh_axis), P(mesh_axis), P(mesh_axis)),
                 out_specs=P(), check_vma=False))
 
             def program(inputs):
-                return sharded_fn(inputs, sched_sharded)
+                return sharded_fn(inputs, sched_dev_sh, own_dev_sh,
+                                  exp_idx_dev_sh)
+
+            program.export_width = X_max
+            program.lanes_per_shard = Wn
 
     else:
         # ---- dynamic frontier (lax.while_loop) ------------------------------
@@ -633,4 +718,8 @@ def compile_jax_dag(
         num_shards=n_sh if mesh is not None else 1,
     )
     dag.num_compiled_tasks = C
+    # Sharded-exchange metadata: lanes run per shard per wave vs payloads
+    # actually shipped over ICI per wave (X_max == 0 ⇒ no collective).
+    dag.export_width = getattr(program, "export_width", None)
+    dag.lanes_per_shard = getattr(program, "lanes_per_shard", None)
     return dag

@@ -1,0 +1,154 @@
+"""Thin web dashboard over the state API (reference role: the Ray
+dashboard's cluster/jobs/actors views — here one stdlib HTTP server with a
+JSON snapshot endpoint and a self-refreshing HTML page, zero new
+dependencies; SURVEY.md §7 step 10's "thin version").
+
+Endpoints:
+- ``GET /``             live HTML overview (auto-refreshes every 2s)
+- ``GET /api/snapshot`` full cluster snapshot as JSON
+- ``GET /api/tasks``    task states (state API passthrough)
+- ``GET /api/actors``   actor states
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #111;
+        color: #ddd; }
+ h1 { color: #7fd7ff; } h2 { color: #9fe8a0; margin-bottom: 0.2em; }
+ table { border-collapse: collapse; margin: 0.5em 0 1.5em; }
+ td, th { border: 1px solid #444; padding: 4px 10px; text-align: left; }
+ th { background: #222; }
+</style></head>
+<body><h1>ray_tpu dashboard</h1><div id="root">loading…</div>
+<script>
+async function refresh() {
+  const r = await fetch('/api/snapshot'); const s = await r.json();
+  const row = (k, v) => `<tr><td>${k}</td><td>${v}</td></tr>`;
+  const table = (obj) => '<table>' + Object.entries(obj).map(
+      ([k, v]) => row(k, JSON.stringify(v))).join('') + '</table>';
+  document.getElementById('root').innerHTML =
+    '<h2>resources</h2>' + table(s.resources) +
+    '<h2>tasks</h2>' + table(s.tasks) +
+    '<h2>actors</h2>' + table(s.actors) +
+    '<h2>object store</h2>' + table(s.object_store) +
+    '<h2>workers</h2>' + table(s.workers);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def _snapshot() -> dict:
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util.state import (
+        list_actors,
+        summarize_actors,
+        summarize_objects,
+        summarize_tasks,
+    )
+
+    w = global_worker()
+    shm = None
+    if w.shm_store is not None:
+        shm = w.shm_store.stats()
+    pool = w.worker_pool
+    return {
+        "resources": {
+            "total": w.resource_pool.total,
+            "available": w.resource_pool.available(),
+        },
+        "tasks": summarize_tasks(),
+        "actors": {
+            "summary": summarize_actors(),
+            "named": sorted(n for _, n in w.named_actors),
+        },
+        "object_store": {
+            "python_store_objects": len(getattr(w.store, "_entries", {})),
+            "shm": shm,
+        },
+        "workers": {
+            "mode": w.worker_mode,
+            "pool_size": pool.size if pool is not None else 0,
+            "pids": pool.pids() if pool is not None else [],
+            "session_dir": w.session_dir,
+        },
+        "actors_detail": list_actors(limit=100),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        try:
+            if self.path.startswith("/api/snapshot"):
+                payload = json.dumps(_snapshot(), default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/tasks"):
+                from ray_tpu.util.state import list_tasks
+
+                payload = json.dumps(list_tasks(limit=1000),
+                                     default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/actors"):
+                from ray_tpu.util.state import list_actors
+
+                payload = json.dumps(list_actors(limit=1000),
+                                     default=str).encode()
+                ctype = "application/json"
+            else:
+                payload = _PAGE.encode()
+                ctype = "text/html"
+            self.send_response(200)
+        except Exception as exc:  # noqa: BLE001 — snapshot error boundary
+            payload = json.dumps({"error": repr(exc)}).encode()
+            ctype = "application/json"
+            self.send_response(500)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ray_tpu_dashboard")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard
+
+
+def stop_dashboard():
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

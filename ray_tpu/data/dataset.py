@@ -32,6 +32,8 @@ from ray_tpu.data.executor import (
     LimitOperator,
     MapOperator,
     Operator,
+    RangeShuffleOperator,
+    ShuffleOperator,
     execute_plan,
 )
 from ray_tpu.data.grouped import GroupedData
@@ -166,24 +168,34 @@ class Dataset:
             f"Repartition[{num_blocks}]", fn))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        def fn(blocks: List[Block]) -> List[Block]:
-            merged = concat_blocks(blocks)
+        # Two-stage push shuffle: map tasks scatter rows to partitions,
+        # reduce tasks permute each partition locally — no whole-dataset
+        # barrier on the driver.
+        def partition(block: Block, P: int, idx: int) -> List[Block]:
+            n = block_num_rows(block)
+            rng = np.random.default_rng(
+                None if seed is None else seed + idx * 9973)
+            assign = rng.integers(0, P, size=n)
+            return [block_take_indices(block, np.nonzero(assign == p)[0])
+                    for p in range(P)]
+
+        def reduce(parts: List[Block], p: int) -> List[Block]:
+            merged = concat_blocks(parts)
             n = block_num_rows(merged)
             if n == 0:
                 return []
-            rng = np.random.default_rng(seed)
-            idx = rng.permutation(n)
-            k = max(len(blocks), 1)
-            shuffled = block_take_indices(merged, idx)
-            per = math.ceil(n / k)
-            return [block_slice(shuffled, i * per, min((i + 1) * per, n))
-                    for i in range(k) if i * per < n]
+            rng = np.random.default_rng(
+                None if seed is None else seed * 31 + p)
+            return [block_take_indices(merged, rng.permutation(n))]
 
-        return self._append(AllToAllOperator("RandomShuffle", fn))
+        return self._append(ShuffleOperator(
+            "RandomShuffle", partition, reduce))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        def fn(blocks: List[Block]) -> List[Block]:
-            merged = concat_blocks(blocks)
+        # Range-partitioned shuffle sort: sampled boundaries, per-range
+        # reduce sorts, ordered concat is globally sorted.
+        def reduce(parts: List[Block], _p: int) -> List[Block]:
+            merged = concat_blocks(parts)
             if block_num_rows(merged) == 0:
                 return []
             idx = np.argsort(merged[key], kind="stable")
@@ -191,7 +203,8 @@ class Dataset:
                 idx = idx[::-1]
             return [block_take_indices(merged, idx)]
 
-        return self._append(AllToAllOperator(f"Sort({key})", fn))
+        return self._append(RangeShuffleOperator(
+            f"Sort({key})", key, reduce, descending=descending))
 
     def groupby(self, key: str) -> GroupedData:
         return GroupedData(self, key)
@@ -312,7 +325,23 @@ class Dataset:
         return out
 
     def streaming_split(self, n: int) -> List["MaterializedDataset"]:
-        return self.split(n)
+        """Split by assigning whole blocks round-robin (greedy by rows) —
+        no merge/re-slice of the dataset, so shards stream their blocks
+        directly (the train-ingest path; reference: streaming_split
+        returns block-iterators per consumer)."""
+        mat = self.materialize()
+        shard_refs: List[List[Any]] = [[] for _ in range(n)]
+        shard_metas: List[List[BlockMetadata]] = [[] for _ in range(n)]
+        shard_rows = [0] * n
+        pairs = sorted(zip(mat._refs, mat._metas),
+                       key=lambda rm: -rm[1].num_rows)
+        for ref, meta in pairs:  # largest block to lightest shard
+            i = shard_rows.index(min(shard_rows))
+            shard_refs[i].append(ref)
+            shard_metas[i].append(meta)
+            shard_rows[i] += meta.num_rows
+        return [MaterializedDataset(shard_refs[i], shard_metas[i], None)
+                for i in range(n)]
 
     # --------------------------------------------------------------- writes
     def write_parquet(self, path: str) -> None:

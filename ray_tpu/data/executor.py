@@ -78,6 +78,170 @@ class MapOperator(Operator):
         return flat
 
 
+def _compose_block_fns(f, g):
+    def composed(block):
+        out = []
+        for b in f(block):
+            out.extend(g(b))
+        return out
+
+    return composed
+
+
+def fuse_plan(operators: List[Operator]) -> List[Operator]:
+    """Map fusion (reference role: the logical-plan OperatorFusionRule):
+    adjacent map-class operators collapse into ONE task per block, and a
+    map directly after a read fuses into the read task itself — so a
+    ``read -> map -> map_batches`` pipeline costs one task per block, not
+    three."""
+    fused: List[Operator] = []
+    for op in operators:
+        prev = fused[-1] if fused else None
+        if isinstance(op, MapOperator) and isinstance(prev, MapOperator):
+            fused[-1] = MapOperator(
+                f"{prev.name}->{op.name}",
+                _compose_block_fns(prev._block_fn, op._block_fn),
+                max_in_flight=min(prev._max_in_flight, op._max_in_flight))
+            continue
+        if isinstance(op, MapOperator) and isinstance(prev, InputOperator):
+            g = op._block_fn
+
+            def _wrap(task, g=g):
+                def read_then_map():
+                    out = []
+                    for b in task():
+                        out.extend(g(b))
+                    return out
+
+                return read_then_map
+
+            fused[-1] = InputOperator(
+                f"{prev.name}->{op.name}",
+                [_wrap(t) for t in prev._read_tasks],
+                max_in_flight=prev._max_in_flight)
+            continue
+        fused.append(op)
+    return fused
+
+
+class ShuffleOperator(Operator):
+    """Two-stage push shuffle (reference role: push-based shuffle /
+    ShuffleTaskScheduler): map tasks partition each input block into P
+    parts, then one reduce task per partition combines its parts from
+    every map. Both stages run as parallel ray_tpu tasks; the driver
+    never concatenates the whole dataset (the old barrier behavior)."""
+
+    MAX_PARTITIONS = 32
+
+    def __init__(self, name: str, partition_fn, reduce_fn,
+                 num_partitions: Optional[int] = None):
+        self.name = name
+        self._partition_fn = partition_fn  # (block, P, block_idx) -> [P]
+        self._reduce_fn = reduce_fn        # (List[Block], p) -> List[Block]
+        self._num_partitions = num_partitions
+
+    def _choose_partitions(self, in_refs) -> int:
+        return self._num_partitions or min(
+            max(len(in_refs), 1), self.MAX_PARTITIONS)
+
+    def execute(self, in_refs, stats):
+        t0 = time.perf_counter()
+        if not in_refs:
+            stats.ops.append(OpStats(self.name, 0.0, 0, 0))
+            return []
+        P = self._choose_partitions(in_refs)
+        part = self._partition_fn
+        red = self._reduce_fn
+
+        @ray_tpu.remote
+        def _map(block, idx):
+            parts = part(block, P, idx)
+            return tuple(parts) if P > 1 else parts[0]
+
+        @ray_tpu.remote
+        def _reduce(p, *parts):
+            return red(list(parts), p)
+
+        map_refs = []
+        for i, ref in enumerate(in_refs):
+            if P > 1:
+                map_refs.append(
+                    _map.options(num_returns=P).remote(ref, i))
+            else:
+                map_refs.append([_map.remote(ref, i)])
+        out_refs: List[Any] = []
+        rows = 0
+        reduce_refs = [
+            _reduce.remote(p, *[m[p] for m in map_refs]) for p in range(P)
+        ]
+        for rref in reduce_refs:  # partition order IS output order
+            for b in ray_tpu.get(rref):
+                rows += block_num_rows(b)
+                out_refs.append(ray_tpu.put(b))
+        stats.ops.append(OpStats(
+            name=self.name, wall_s=time.perf_counter() - t0,
+            output_blocks=len(out_refs), output_rows=rows))
+        return out_refs
+
+
+class RangeShuffleOperator(ShuffleOperator):
+    """Range-partitioned shuffle: samples the key column to pick P-1
+    boundaries, partitions by ``searchsorted``, reduces per range — so
+    ordered concatenation of partition outputs is globally key-ordered
+    (what sort and sorted groupby need)."""
+
+    def __init__(self, name: str, key: str, reduce_fn,
+                 descending: bool = False,
+                 num_partitions: Optional[int] = None):
+        self.key = key
+        self.descending = descending
+        super().__init__(name, None, reduce_fn,
+                         num_partitions=num_partitions)
+
+    def execute(self, in_refs, stats):
+        if not in_refs:
+            stats.ops.append(OpStats(self.name, 0.0, 0, 0))
+            return []
+        P = self._choose_partitions(in_refs)
+        key, desc = self.key, self.descending
+
+        @ray_tpu.remote
+        def _sample(block):
+            vals = np.asarray(block[key])
+            if len(vals) == 0:
+                return vals
+            k = min(len(vals), 64)
+            sel = np.linspace(0, len(vals) - 1, k).astype(np.int64)
+            return np.sort(vals)[sel]
+
+        samples = np.concatenate(
+            [np.asarray(s) for s in
+             ray_tpu.get([_sample.remote(r) for r in in_refs])])
+        if len(samples) and P > 1:
+            samples = np.sort(samples)
+            if samples.dtype.kind in "iuf":
+                qs = np.linspace(0.0, 1.0, P + 1)[1:-1]
+                bounds = np.quantile(samples, qs)
+            else:  # strings etc.: evenly spaced sorted sample elements
+                sel = np.linspace(0, len(samples) - 1, P + 1)[1:-1]
+                bounds = samples[sel.astype(np.int64)]
+        else:
+            bounds = np.asarray([])
+
+        def partition(block, P, _idx, bounds=bounds):
+            vals = np.asarray(block[key])
+            pidx = np.searchsorted(bounds, vals, side="right")
+            if desc:
+                pidx = (P - 1) - pidx
+            from ray_tpu.data.block import block_take_indices as take
+
+            return [take(block, np.nonzero(pidx == p)[0])
+                    for p in range(P)]
+
+        self._partition_fn = partition
+        return super().execute(in_refs, stats)
+
+
 class AllToAllOperator(Operator):
     """Barrier operator: consumes all blocks, emits a new block list."""
 
@@ -166,7 +330,7 @@ def execute_plan(operators: List[Operator]) -> (List[Any], DatasetStats):
     stats = DatasetStats()
     t0 = time.perf_counter()
     refs: List[Any] = []
-    for op in operators:
+    for op in fuse_plan(operators):
         refs = op.execute(refs, stats)
     stats.total_wall_s = time.perf_counter() - t0
     return refs, stats

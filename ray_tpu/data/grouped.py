@@ -12,7 +12,7 @@ from ray_tpu.data.block import (
     block_take_indices,
     concat_blocks,
 )
-from ray_tpu.data.executor import AllToAllOperator
+from ray_tpu.data.executor import RangeShuffleOperator
 
 
 class AggregateFn:
@@ -92,8 +92,13 @@ class GroupedData:
 
         from ray_tpu.data.dataset import Dataset
 
+        # Range-partitioned shuffle on the key: each reduce aggregates its
+        # disjoint key range, so the ordered concat is globally key-sorted
+        # (same output contract as the old whole-dataset barrier).
         return Dataset(self._dataset._operators + [
-            AllToAllOperator(f"GroupByAggregate({key})", fn)])
+            RangeShuffleOperator(
+                f"GroupByAggregate({key})", key,
+                lambda parts, _p: fn(parts))])
 
     def count(self):
         return self.aggregate(Count())
@@ -131,4 +136,6 @@ class GroupedData:
         from ray_tpu.data.dataset import Dataset
 
         return Dataset(self._dataset._operators + [
-            AllToAllOperator(f"MapGroups({key})", gfn)])
+            RangeShuffleOperator(
+                f"MapGroups({key})", key,
+                lambda parts, _p: gfn(parts))])

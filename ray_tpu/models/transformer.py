@@ -162,7 +162,14 @@ def rope(x, positions, theta):
 
 
 def _attention_dense(q, k, v, causal=True):
-    """q [B,S,Hq,Dh], k/v [B,S,Hkv,Dh] -> [B,S,Hq,Dh] (GQA via repeat)."""
+    """q [B,S,Hq,Dh], k/v [B,S,Hkv,Dh] -> [B,S,Hq,Dh] (GQA via repeat).
+
+    On TPU with tileable shapes this dispatches to the Pallas flash
+    kernel (ops/flash_attention.py, differentiable via its blockwise
+    custom_vjp) — the [S, S] score matrix never hits HBM, which is what
+    unlocks long sequences and large batches under grad. Other
+    shapes/backends take the dense einsum path.
+    """
     B, S, Hq, Dh = q.shape
     Hkv = k.shape[2]
     if Hq != Hkv:
@@ -171,6 +178,12 @@ def _attention_dense(q, k, v, causal=True):
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu and S >= 128 and S % 128 == 0 and Dh % 8 == 0:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(qT, kT, vT, causal=causal)
+        return o.transpose(0, 2, 1, 3)
     s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * (Dh ** -0.5)
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))

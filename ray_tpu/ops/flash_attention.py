@@ -100,9 +100,15 @@ def flash_attention(
         return _fallback(q, k, v, causal, scale)
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
+    return _flash_core(q, k, v, causal, scale, block_q, block_k,
+                       bool(interpret))
 
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
 
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, seq_k=Sk, causal=causal,
         scale=scale, block_q=block_q)
@@ -124,3 +130,82 @@ def flash_attention(
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(B, H, Sq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
+                    res, dout):
+    """Flash-attention backward: blockwise recomputation over k-blocks as
+    a ``lax.scan`` — the [S, S] score matrix never materializes (the same
+    memory contract as the forward kernel; XLA maps the per-block matmuls
+    straight onto the MXU)."""
+    q, k, v, out = res
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    nb = Sk // block_k
+    f32 = jnp.float32
+
+    def per_head(qh, kh, vh, oh, doh):
+        # qh [Sq, D], kh/vh [Sk, D]; all f32.
+        kb = kh.reshape(nb, block_k, D)
+        vb = vh.reshape(nb, block_k, D)
+        q_pos = jnp.arange(Sq)
+
+        def scores(j):
+            s = (qh @ kb[j].T) * scale                  # [Sq, Bk]
+            if causal:
+                k_pos = j * block_k + jnp.arange(block_k)
+                s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+            return s
+
+        # Pass 1: online softmax stats (running max + normalizer).
+        def stats_step(carry, j):
+            m, l = carry
+            s = scores(j)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            l = l * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(s - m_new[:, None]), axis=-1)
+            return (m_new, l), None
+
+        (m, l), _ = lax.scan(
+            stats_step,
+            (jnp.full((Sq,), NEG_INF, f32), jnp.zeros((Sq,), f32)),
+            jnp.arange(nb))
+        l = jnp.maximum(l, 1e-30)
+        delta = jnp.sum(doh * oh, axis=-1)              # [Sq]
+
+        # Pass 2: gradients per k-block (dq accumulates; dk/dv stack).
+        def grad_step(dq, j):
+            s = scores(j)
+            p = jnp.exp(s - m[:, None]) / l[:, None]    # [Sq, Bk]
+            dv_j = p.T @ doh                            # [Bk, D]
+            dp = doh @ vb[j].T                          # [Sq, Bk]
+            ds = p * (dp - delta[:, None])              # [Sq, Bk]
+            dq = dq + (ds @ kb[j]) * scale
+            dk_j = (ds.T @ qh) * scale                  # [Bk, D]
+            return dq, (dk_j, dv_j)
+
+        dq, (dk_b, dv_b) = lax.scan(
+            grad_step, jnp.zeros((Sq, D), f32), jnp.arange(nb))
+        return dq, dk_b.reshape(Sk, D), dv_b.reshape(Sk, D)
+
+    flat = lambda x: x.reshape(B * H, x.shape[2], D).astype(f32)  # noqa: E731
+    dq, dk, dv = jax.vmap(per_head)(
+        flat(q), flat(k), flat(v), flat(out), flat(dout))
+    return (dq.reshape(q.shape).astype(q.dtype),
+            dk.reshape(k.shape).astype(k.dtype),
+            dv.reshape(v.shape).astype(v.dtype))
+
+
+_flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)

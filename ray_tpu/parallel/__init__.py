@@ -24,6 +24,11 @@ from ray_tpu.parallel.ring_attention import ring_attention
 from ray_tpu.parallel.ulysses import ulysses_attention
 from ray_tpu.parallel.moe import moe_dispatch_combine
 from ray_tpu.parallel.pipeline import pipeline_spmd
+from ray_tpu.parallel import distributed
+from ray_tpu.parallel.distributed import (
+    HybridMeshConfig,
+    make_hybrid_mesh,
+)
 
 __all__ = [
     "MeshConfig",

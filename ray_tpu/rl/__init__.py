@@ -11,6 +11,8 @@ CartPole rollout sweeps millions.
 
 from ray_tpu.rl.env import CartPole, JaxEnv, Pendulum
 from ray_tpu.rl.ppo import PPOConfig, PPOLearner
+from ray_tpu.rl.dqn import DQNConfig, DQNLearner
+from ray_tpu.rl.replay import ReplayBuffer
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.env_runner import EnvRunner
 
@@ -18,9 +20,12 @@ __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "CartPole",
+    "DQNConfig",
+    "DQNLearner",
     "EnvRunner",
     "JaxEnv",
     "PPOConfig",
     "PPOLearner",
     "Pendulum",
+    "ReplayBuffer",
 ]

@@ -22,13 +22,15 @@ class AlgorithmConfig:
     """Builder: config.environment(...).env_runners(...).training(...)."""
 
     def __init__(self, algo: str = "PPO"):
+        from ray_tpu.rl.dqn import DQNConfig
+
         self.algo = algo
         self.env_name = "CartPole-v1"
         self.env_factory = None
         self.num_env_runners = 0
         self.num_envs_per_runner = 64
         self.rollout_len = 128
-        self.train_config = PPOConfig()
+        self.train_config = (DQNConfig() if algo == "DQN" else PPOConfig())
         self.seed = 0
 
     def environment(self, env: str = None, *, env_factory=None
@@ -69,10 +71,13 @@ class Algorithm:
     """PPO training loop over (possibly remote) env runners."""
 
     def __init__(self, config: AlgorithmConfig):
-        if config.algo != "PPO":
+        from ray_tpu.rl.dqn import DQNLearner
+
+        if config.algo not in ("PPO", "DQN"):
             raise NotImplementedError(
-                f"algorithm {config.algo!r}; PPO is implemented natively — "
-                f"add algorithms via PPOLearner-style Learner classes")
+                f"algorithm {config.algo!r}; PPO (on-policy) and DQN "
+                f"(off-policy replay) are implemented natively — add "
+                f"algorithms via Learner classes with get_weights/update")
         self.config = config
         factory = config.env_factory or _ENVS.get(config.env_name)
         if factory is None:
@@ -80,8 +85,12 @@ class Algorithm:
                 f"unknown env {config.env_name!r}; pass env_factory or one "
                 f"of {list(_ENVS)}")
         self.env: JaxEnv = factory()
-        self.learner = PPOLearner(self.env, config.train_config,
-                                  config.seed)
+        if config.algo == "DQN":
+            self.learner = DQNLearner(self.env, config.train_config,
+                                      config.seed)
+        else:
+            self.learner = PPOLearner(self.env, config.train_config,
+                                      config.seed)
         if config.num_env_runners > 0:
             ray_tpu.init(ignore_reinit_error=True)
             self._runners = [

@@ -1,0 +1,64 @@
+"""Replay buffer (reference role: rllib/utils/replay_buffers —
+EpisodeReplayBuffer's uniform-sampling core).
+
+A flat numpy ring over transitions. Rollouts arrive as [T, N] batches from
+the shared EnvRunner and are flattened in; sampling returns jnp-ready
+minibatches for the off-policy learners (DQN).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int = 50_000):
+        self.capacity = int(capacity)
+        self._store: Optional[Dict[str, np.ndarray]] = None
+        self._next = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_rollout(self, obs, actions, rewards, dones, next_obs):
+        """Flatten [T, N, ...] rollout arrays into transitions and append.
+        """
+        batch = {
+            "obs": np.asarray(obs).reshape(-1, np.asarray(obs).shape[-1]),
+            "actions": np.asarray(actions).reshape(-1),
+            "rewards": np.asarray(rewards).reshape(-1),
+            "dones": np.asarray(dones).reshape(-1).astype(np.float32),
+            "next_obs": np.asarray(next_obs).reshape(
+                -1, np.asarray(next_obs).shape[-1]),
+        }
+        n = len(batch["actions"])
+        if self._store is None:
+            self._store = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()
+            }
+        for start in range(0, n, self.capacity):
+            chunk = {k: v[start:start + self.capacity]
+                     for k, v in batch.items()}
+            m = len(chunk["actions"])
+            end = self._next + m
+            if end <= self.capacity:
+                for k, v in chunk.items():
+                    self._store[k][self._next:end] = v
+            else:
+                split = self.capacity - self._next
+                for k, v in chunk.items():
+                    self._store[k][self._next:] = v[:split]
+                    self._store[k][:m - split] = v[split:]
+            self._next = end % self.capacity
+            self._size = min(self._size + m, self.capacity)
+
+    def sample(self, batch_size: int,
+               rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        if self._size == 0:
+            raise ValueError("replay buffer is empty")
+        idx = rng.integers(0, self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._store.items()}

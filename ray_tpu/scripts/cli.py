@@ -82,6 +82,27 @@ def cmd_job(args):
     raise SystemExit(f"unknown job command {args.job_cmd!r}")
 
 
+def cmd_logs(args):
+    """List or print worker log files of a session (reference: `ray logs`).
+    """
+    import os
+
+    from ray_tpu._private.log_monitor import latest_session_dir, \
+        list_log_files
+
+    session = args.session or latest_session_dir()
+    log_dir = os.path.join(session, "logs")
+    if args.filename:
+        path = os.path.join(log_dir, args.filename)
+        with open(path, "r", errors="replace") as f:
+            print(f.read(), end="")
+        return
+    print(f"session: {session}")
+    for fname in list_log_files(log_dir):
+        size = os.path.getsize(os.path.join(log_dir, fname))
+        print(f"  {fname}  ({size} bytes)")
+
+
 def cmd_version(args):
     import ray_tpu
 
@@ -106,6 +127,10 @@ def main(argv=None):
     p.add_argument("job_cmd", choices=["submit"])
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_job)
+    p = sub.add_parser("logs")
+    p.add_argument("filename", nargs="?", default=None)
+    p.add_argument("--session", default=None)
+    p.set_defaults(fn=cmd_logs)
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     args = parser.parse_args(argv)

@@ -13,6 +13,7 @@ from ray_tpu.serve.api import (
     Deployment,
     batch,
     delete,
+    deploy_config,
     deployment,
     get_deployment_handle,
     ingress,
@@ -22,15 +23,21 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 
 __all__ = [
     "Application",
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "batch",
     "delete",
+    "deploy_config",
     "deployment",
     "get_deployment_handle",
     "ingress",

@@ -101,6 +101,53 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
     return handle
 
 
+def deploy_config(config, *, start_http: bool = False) -> Dict[str, Any]:
+    """Config-file deploy (reference role: `serve deploy config.yaml` —
+    the declarative REST/config schema, subset): a dict, YAML, or JSON
+    file with ``applications: [{import_path: "module:app", name: ...,
+    deployments: [{name, num_replicas, autoscaling_config}]}]``.
+    ``import_path`` resolves to an Application (or a Deployment, which is
+    bound with no args); per-deployment overrides apply before deploy.
+    Returns {app_name: handle}."""
+    import importlib
+    import json as _json
+
+    if isinstance(config, dict):
+        cfg = config
+    else:
+        with open(config) as f:
+            text = f.read()
+        try:
+            import yaml
+
+            cfg = yaml.safe_load(text)
+        except ImportError:
+            cfg = _json.loads(text)
+    handles: Dict[str, Any] = {}
+    for app_cfg in cfg.get("applications", []):
+        mod_name, _, attr = app_cfg["import_path"].partition(":")
+        target = getattr(importlib.import_module(mod_name), attr)
+        app = target.bind() if isinstance(target, Deployment) else target
+        if not isinstance(app, Application):
+            raise TypeError(
+                f"{app_cfg['import_path']} is not an Application or "
+                f"Deployment")
+        overrides = {d["name"]: d for d in app_cfg.get("deployments", [])}
+        o = overrides.get(app.deployment.name)
+        if o:
+            opts = {k: v for k, v in o.items() if k != "name"}
+            app = Application(app.deployment.options(**opts),
+                              app.args, app.kwargs)
+        handles[app_cfg.get("name", app.deployment.name)] = run(app)
+    if start_http:
+        from ray_tpu.serve.http import start_proxy
+
+        http_cfg = cfg.get("http_options", {})
+        start_proxy(host=http_cfg.get("host", "127.0.0.1"),
+                    port=int(http_cfg.get("port", 8000)))
+    return handles
+
+
 def start(detached: bool = False, **_opts):
     ray_tpu.init(ignore_reinit_error=True)
     get_or_create_controller()

@@ -139,6 +139,39 @@ class ServeController:
                         f"callable")
                 return fn(*args, **kwargs)
 
+            def handle_stream(self, method, args, kwargs, stream_id):
+                """Generator method: items stream through the driver KV
+                under (stream_id, seq) keys — the response generator on
+                the caller side polls them in order (chunked-response
+                parity; works from thread or process replicas alike)."""
+                import pickle as _pickle
+
+                from ray_tpu._private.worker import auto_init
+
+                w = auto_init()
+                args = tuple(
+                    ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef)
+                    else a for a in args)
+                kwargs = {
+                    k: (ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef)
+                        else v)
+                    for k, v in kwargs.items()
+                }
+                fn = (self._user if method == "__call__"
+                      else getattr(self._user, method))
+                seq = 0
+                try:
+                    for item in fn(*args, **kwargs):
+                        w.kv_put(f"serve|stream|{stream_id}|{seq}".encode(),
+                                 _pickle.dumps(item, protocol=5))
+                        seq += 1
+                except Exception as exc:  # noqa: BLE001 — stream error
+                    w.kv_put(f"serve|stream|{stream_id}|err".encode(),
+                             _pickle.dumps(exc))
+                w.kv_put(f"serve|stream|{stream_id}|end".encode(),
+                         str(seq).encode())
+                return seq
+
             def health_check(self):
                 return True
 

@@ -50,17 +50,73 @@ class DeploymentResponse:
             pass
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate items as the replica's generator yields
+    them (reference: handle.options(stream=True) generator semantics).
+    Items arrive through the driver KV under (stream_id, seq) keys."""
+
+    def __init__(self, ref, replica_set, replica_key, stream_id: str):
+        self._inner = DeploymentResponse(ref, replica_set, replica_key)
+        self._stream_id = stream_id
+        self._seq = 0
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import pickle
+        import time
+
+        from ray_tpu._private.worker import global_worker
+
+        if self._done:
+            raise StopIteration
+        w = global_worker()
+        base = f"serve|stream|{self._stream_id}"
+        deadline = time.monotonic() + 60.0
+        while True:
+            raw = w.kv_get(f"{base}|{self._seq}".encode())
+            if raw is not None:
+                w.kv_del(f"{base}|{self._seq}".encode())
+                self._seq += 1
+                return pickle.loads(raw)
+            err = w.kv_get(f"{base}|err".encode())
+            if err is not None:
+                w.kv_del(f"{base}|err".encode())
+                self._finish(w, base)
+                raise pickle.loads(err)
+            end = w.kv_get(f"{base}|end".encode())
+            if end is not None and self._seq >= int(end):
+                self._finish(w, base)
+                raise StopIteration
+            if time.monotonic() > deadline:
+                self._finish(w, base)
+                raise TimeoutError("stream stalled for 60s")
+            time.sleep(0.002)
+
+    def _finish(self, w, base):
+        self._done = True
+        w.kv_del(f"{base}|end".encode())
+        self._inner._release()
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self._name = deployment_name
         self._controller = controller
         self._method = method_name
+        self._stream = stream
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, self._controller, method_name)
+    def options(self, method_name: Optional[str] = None, *,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._name, self._controller,
+            method_name if method_name is not None else self._method,
+            stream=self._stream if stream is None else stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         rs = self._controller._replica_set(self._name)
         key, replica = rs.choose()
         # Chain: unwrap DeploymentResponses into ObjectRefs so downstream
@@ -73,10 +129,17 @@ class DeploymentHandle:
                 else v)
             for k, v in kwargs.items()
         }
+        self._controller._record_request(self._name)
+        if self._stream:
+            import uuid
+
+            stream_id = uuid.uuid4().hex
+            ref = replica.handle_stream.remote(
+                self._method, args, kwargs, stream_id)
+            return DeploymentResponseGenerator(ref, rs, key, stream_id)
         method = getattr(replica, "handle_request")
         ref = method.remote(self._method, args, kwargs)
         resp = DeploymentResponse(ref, rs, key, replica=replica)
-        self._controller._record_request(self._name)
         return resp
 
     def __getattr__(self, item):

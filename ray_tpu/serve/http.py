@@ -22,7 +22,10 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _route(self):
-        name = self.path.strip("/").split("/")[0]
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(self.path)
+        name = parsed.path.strip("/").split("/")[0]
         if not name:
             self.send_response(404)
             self.end_headers()
@@ -30,9 +33,38 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0) or 0)
         body = self.rfile.read(length) if length else b""
+        stream = parse_qs(parsed.query).get(
+            "stream", ["0"])[0] in ("1", "true")
         try:
             arg = json.loads(body) if body else None
             handle = DeploymentHandle(name, get_or_create_controller())
+            if stream:
+                # Chunked transfer: one JSON line per generator item, sent
+                # as the replica yields (reference: streaming responses
+                # over the proxy).
+                gen = (handle.options(stream=True).remote(arg)
+                       if arg is not None
+                       else handle.options(stream=True).remote())
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for item in gen:
+                        chunk = (json.dumps(item) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(chunk):X}\r\n".encode() + chunk
+                            + b"\r\n")
+                except Exception as exc:  # noqa: BLE001 — mid-stream error
+                    # Headers are already on the wire: the error must ride
+                    # the chunked framing (a 500 here would corrupt the
+                    # stream), then the stream terminates cleanly.
+                    chunk = (json.dumps({"error": repr(exc)})
+                             + "\n").encode()
+                    self.wfile.write(
+                        f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+                return
             result = (handle.remote(arg) if arg is not None
                       else handle.remote()).result(timeout=30)
             payload = json.dumps({"result": result}).encode()

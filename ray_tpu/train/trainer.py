@@ -136,24 +136,29 @@ class JaxTrainer:
         from ray_tpu.train.session import _report_key
 
         worker = global_worker()
-        next_seq = 0
+        next_seq = [0] * n
         history: List[Dict[str, Any]] = []
         latest_metrics: Dict[str, Any] = {}
         latest_ckpt = restore_from
 
         def _drain():
-            nonlocal next_seq, latest_metrics, latest_ckpt
-            while True:
-                raw = worker.kv_get(_report_key(run_id, 0, next_seq))
-                if raw is None:
-                    return
-                worker.kv_del(_report_key(run_id, 0, next_seq))
-                next_seq += 1
-                metrics, ckpt = _pickle.loads(raw)
-                history.append(metrics)
-                latest_metrics = metrics
-                if ckpt is not None:
-                    latest_ckpt = self._persist(ckpt)
+            nonlocal latest_metrics, latest_ckpt
+            for rank in range(n):
+                while True:
+                    raw = worker.kv_get(
+                        _report_key(run_id, rank, next_seq[rank]))
+                    if raw is None:
+                        break
+                    worker.kv_del(
+                        _report_key(run_id, rank, next_seq[rank]))
+                    next_seq[rank] += 1
+                    if rank != 0:
+                        continue  # non-rank-0 reports: consumed, discarded
+                    metrics, ckpt = _pickle.loads(raw)
+                    history.append(metrics)
+                    latest_metrics = metrics
+                    if ckpt is not None:
+                        latest_ckpt = self._persist(ckpt)
 
         pending = list(run_refs)
         try:

@@ -298,3 +298,90 @@ def test_jax_sharded_width_not_divisible(ray_start_regular):
     sharded = dag.experimental_compile(
         backend="jax", mesh=_dag_mesh(), mesh_axis="dag")
     assert float(sharded.execute(0.0).get()) == 13.0
+
+
+def test_jax_sharded_tensor_payload_parity(ray_start_regular):
+    """North-star scale check: ~1k tasks with (1024,) float32 payloads on
+    the 8-device mesh match the single-device result (partially-replicated
+    table + compacted exchange)."""
+    import jax.numpy as jnp
+
+    @ray_tpu.remote
+    def scale(x):
+        return x * 1.001 + 0.5
+
+    @ray_tpu.remote
+    def merge(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        chains = []
+        for _ in range(64):  # 64 independent chains of 15 -> 960 tasks
+            node = inp
+            for _ in range(15):
+                node = scale.bind(node)
+            chains.append(node)
+        while len(chains) > 1:  # + 63 merge tasks crossing shards
+            chains = [merge.bind(chains[i], chains[i + 1])
+                      for i in range(0, len(chains), 2)]
+        dag = chains[0]
+    single = dag.experimental_compile(
+        backend="jax", payload_shape=(1024,), fuse=True)
+    sharded = dag.experimental_compile(
+        backend="jax", payload_shape=(1024,), fuse=True,
+        mesh=_dag_mesh(), mesh_axis="dag")
+    x = np.linspace(0.0, 1.0, 1024, dtype=np.float32)
+    np.testing.assert_allclose(
+        sharded.execute(x).get(), single.execute(x).get(), rtol=1e-5)
+
+
+def test_jax_sharded_exchange_is_compacted(ray_start_regular):
+    """Shard-local chains must export (almost) nothing; the per-wave
+    exchange is sized by cross-shard edges, not wave width."""
+
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1.0
+
+    @ray_tpu.remote
+    def pair(a, b):
+        return a + b
+
+    # 32 unfusable diamonds per shard-slice: every chain interior has 2
+    # consumers on the SAME shard under locality-aware assignment.
+    with InputNode() as inp:
+        outs = []
+        for _ in range(32):
+            h = bump.bind(inp)
+            l, r = bump.bind(h), bump.bind(h)
+            outs.append(pair.bind(l, r))
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = pair.bind(acc, o)
+        dag = acc
+    sharded = dag.experimental_compile(
+        backend="jax", fuse=False, mesh=_dag_mesh(), mesh_axis="dag")
+    # Diamond interiors stay shard-local; only the final fan-in chain
+    # crosses shards. The exchange must be far narrower than the wave.
+    assert sharded.export_width is not None
+    assert sharded.export_width <= 4
+    assert sharded.lanes_per_shard >= 8
+    # Each diamond: h=1, l=r=2, pair=4; 32 diamonds summed = 128.
+    assert float(sharded.execute(0.0).get()) == 128.0
+
+
+def test_jax_sharded_chain_skips_collective(ray_start_regular):
+    """A pure chain graph owned by one shard compiles with zero exports
+    (X_max == 0: no all_gather in the program at all)."""
+    with InputNode() as inp:
+        node = inp
+        for _ in range(24):
+            node = inc.bind(node)
+        dag = node
+    sharded = dag.experimental_compile(
+        backend="jax", fuse=False, mesh=_dag_mesh(), mesh_axis="dag")
+    # Every edge is producer->consumer on the same shard except the leaf
+    # (exported to all shards for the replicated output).
+    assert sharded.export_width is not None
+    assert sharded.export_width <= 1
+    assert float(sharded.execute(0.0).get()) == 24.0

@@ -1,0 +1,41 @@
+"""Dashboard tests (reference model: dashboard API smoke tests)."""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+
+def test_dashboard_snapshot_and_page(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    assert ray_tpu.get(f.remote(1)) == 2
+    a = A.options(name="dash_actor").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    dash = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(dash.url + "/api/snapshot",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["resources"]["total"]["CPU"] == 4.0
+        assert snap["tasks"].get("FINISHED", 0) >= 1
+        assert "dash_actor" in snap["actors"]["named"]
+        assert snap["workers"]["mode"] in ("process", "thread")
+        with urllib.request.urlopen(dash.url + "/", timeout=10) as r:
+            page = r.read().decode()
+        assert "ray_tpu dashboard" in page
+        with urllib.request.urlopen(dash.url + "/api/actors",
+                                    timeout=10) as r:
+            actors_raw = r.read().decode()
+        assert "dash_actor" in actors_raw or "A" in actors_raw
+    finally:
+        stop_dashboard()

@@ -66,3 +66,49 @@ def test_remote_env_runners(ray_start_regular):
     result = algo.train()
     assert result["num_env_steps_sampled"] == 2 * 8 * 16
     algo.stop()
+
+
+def test_replay_buffer_ring_and_sample():
+    import numpy as np
+
+    from ray_tpu.rl import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100)
+    obs = np.random.rand(10, 8, 4).astype(np.float32)  # [T, N, D]
+    acts = np.random.randint(0, 2, (10, 8))
+    rews = np.ones((10, 8), np.float32)
+    dones = np.zeros((10, 8), np.float32)
+    buf.add_rollout(obs[:-1], acts[:-1], rews[:-1], dones[:-1], obs[1:])
+    assert len(buf) == 72
+    batch = buf.sample(32, np.random.default_rng(0))
+    assert batch["obs"].shape == (32, 4)
+    assert batch["next_obs"].shape == (32, 4)
+    # Ring wraps: adding 2x capacity keeps size at capacity.
+    for _ in range(4):
+        buf.add_rollout(obs[:-1], acts[:-1], rews[:-1], dones[:-1], obs[1:])
+    assert len(buf) == 100
+
+
+def test_dqn_learns_cartpole():
+    """Off-policy DQN through the SHARED EnvRunner improves episode
+    length on CartPole (same harness as the PPO learning test)."""
+    from ray_tpu.rl import Algorithm, AlgorithmConfig
+
+    algo = (AlgorithmConfig("DQN")
+            .environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=32,
+                         rollout_fragment_length=64)
+            .training(train_steps_per_iter=96, batch_size=128,
+                      min_buffer_size=256, lr=2e-3,
+                      target_update_freq=150)
+            .debugging(seed=0)
+            .build())
+    hist = []
+    for _ in range(22):
+        r = algo.train()
+        hist.append(r["episode_len_mean"])
+    assert np.isfinite(r["loss"])
+    # Episode-length proxy must improve materially over training
+    # (calibrated run: ~23 -> ~60; threshold leaves wide margin).
+    assert np.mean(hist[-3:]) > np.mean(hist[:3]) * 1.8
+    algo.stop()

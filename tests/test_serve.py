@@ -182,3 +182,80 @@ def test_http_proxy_end_to_end():
         assert body["result"] == 123
     finally:
         stop_proxy()
+
+
+def test_streaming_response_over_handle():
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"i": i}
+
+    handle = serve.run(Streamer.bind())
+    items = list(handle.options(stream=True).remote(5))
+    assert items == [{"i": i} for i in range(5)]
+    # Second stream on the same handle works (fresh stream ids).
+    assert len(list(handle.options(stream=True).remote(3))) == 3
+
+
+def test_streaming_error_propagates():
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Bad:
+        def __call__(self, n):
+            yield 1
+            raise RuntimeError("stream boom")
+
+    handle = serve.run(Bad.bind())
+    gen = handle.options(stream=True).remote(1)
+    assert next(gen) == 1
+    with pytest.raises(RuntimeError, match="stream boom"):
+        next(gen)
+
+
+def test_http_chunked_streaming():
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.serve.http import start_proxy, stop_proxy
+
+    @serve.deployment
+    class S:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * 10
+
+    serve.run(S.bind())
+    proxy = start_proxy(port=0)
+    try:
+        url = f"http://{proxy.host}:{proxy.port}/S?stream=1"
+        req = urllib.request.Request(url, data=json.dumps(3).encode())
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            lines = [json.loads(x) for x in resp.read().split() if x]
+        assert lines == [0, 10, 20]
+    finally:
+        stop_proxy()
+
+
+def test_config_file_deploy(tmp_path):
+    import json
+
+    from ray_tpu import serve
+
+    cfg = {
+        "applications": [{
+            "name": "echo_app",
+            "import_path": "tests.serve_config_target:app",
+            "deployments": [{"name": "Echo", "num_replicas": 2}],
+        }],
+    }
+    path = tmp_path / "serve_config.json"
+    path.write_text(json.dumps(cfg))
+    handles = serve.deploy_config(str(path))
+    assert handles["echo_app"].remote("hi").result(timeout=30) == "echo:hi"
+    status = serve.status()
+    assert status["Echo"]["target_replicas"] == 2
