@@ -27,6 +27,14 @@ from ray_tpu._private.worker import (
     wait,
 )
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill
+
+
+def announce_object(ref) -> None:
+    """Publish an object to the head's object directory so OTHER attached
+    drivers can ``ray_tpu.get`` it (requires init(address=...))."""
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().announce_object(ref)
 from ray_tpu.remote_function import RemoteFunction, method, remote
 from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions
@@ -38,6 +46,7 @@ __all__ = [
     "ActorHandle",
     "ObjectRef",
     "RemoteFunction",
+    "announce_object",
     "cancel",
     "exceptions",
     "get",

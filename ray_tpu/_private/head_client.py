@@ -1,0 +1,175 @@
+"""Driver-side connection to the head service (GCS client analogue).
+
+Each attached driver keeps two connections to the head process: a request
+channel for its own RPCs (KV, directories, relayed calls) and an event
+channel the head pushes work through — relayed actor calls from OTHER
+drivers and object pulls — served by a daemon thread against the local
+runtime. A heartbeat thread keeps the membership entry alive; silence
+past the head's timeout marks this driver dead and garbage-collects its
+directory entries (failure detection).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from multiprocessing.connection import Client as _Connect
+from typing import Any, Optional, Tuple
+
+from ray_tpu._private.head_service import AUTHKEY
+
+
+def parse_address(address: str) -> Tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class HeadClient:
+    def __init__(self, address: str,
+                 client_id: Optional[str] = None):
+        self.address = parse_address(address)
+        self.client_id = client_id or f"driver-{uuid.uuid4().hex[:8]}"
+        self._req = _Connect(self.address, authkey=AUTHKEY)
+        self._req.send(("hello", self.client_id, "request"))
+        self._check(self._req.recv())
+        self._event = _Connect(self.address, authkey=AUTHKEY)
+        self._event.send(("hello", self.client_id, "event"))
+        self._check(self._event.recv())
+        # Dedicated heartbeat connection: a long relayed RPC on the
+        # request channel must not starve liveness (the head would mark
+        # this driver dead mid-call and GC its directory entries).
+        self._hb = _Connect(self.address, authkey=AUTHKEY)
+        self._hb.send(("hello", self.client_id, "request"))
+        self._check(self._hb.recv())
+        self._hb_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._event_thread = threading.Thread(
+            target=self._event_loop, daemon=True,
+            name="ray_tpu_head_events")
+        self._event_thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="ray_tpu_head_heartbeat")
+        self._hb_thread.start()
+
+    @staticmethod
+    def _check(reply):
+        status, value = reply
+        if status == "err":
+            raise value
+        return value
+
+    def _request(self, msg: tuple):
+        with self._lock:
+            self._req.send(msg)
+            return self._check(self._req.recv())
+
+    # ------------------------------------------------------------------ kv
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True):
+        return self._request(("kv_put", key, value, overwrite))
+
+    def kv_get(self, key: bytes):
+        return self._request(("kv_get", key))
+
+    def kv_del(self, key: bytes):
+        return self._request(("kv_del", key))
+
+    def kv_keys(self, prefix: bytes = b""):
+        return self._request(("kv_keys", prefix))
+
+    # -------------------------------------------------------------- actors
+    def actor_register(self, namespace: str, name: str, actor_bin: bytes,
+                       class_name: str):
+        return self._request(
+            ("actor_register", namespace, name, actor_bin, class_name))
+
+    def actor_lookup(self, namespace: str, name: str):
+        return self._request(("actor_lookup", namespace, name))
+
+    def actor_deregister(self, namespace: str, name: str):
+        return self._request(("actor_deregister", namespace, name))
+
+    def actor_call(self, owner_id: str, actor_bin: bytes, method: str,
+                   args, kwargs, num_returns: int):
+        value = self._request((
+            "actor_call", owner_id, actor_bin, method,
+            pickle.dumps((args, kwargs), protocol=5), num_returns))
+        return pickle.loads(value)  # serialized results (or raises)
+
+    # ------------------------------------------------------------- objects
+    def object_announce(self, oid_bin: bytes):
+        return self._request(("object_announce", oid_bin))
+
+    def object_pull(self, oid_bin: bytes):
+        return self._request(("object_pull", oid_bin))
+
+    def cluster_info(self) -> dict:
+        return self._request(("cluster_info",))
+
+    # -------------------------------------------------------------- events
+    def _event_loop(self):
+        """Serve relayed work from other drivers against the local
+        runtime (the per-node agent role)."""
+        from ray_tpu._private import worker as worker_mod
+
+        while not self._stop.is_set():
+            try:
+                msg = self._event.recv()
+            except (EOFError, OSError):
+                return
+            try:
+                reply = ("ok", self._handle_event(worker_mod, msg))
+            except Exception as exc:  # noqa: BLE001 — event boundary
+                reply = ("err", exc)
+            try:
+                self._event.send(reply)
+            except (EOFError, OSError):
+                return
+
+    def _handle_event(self, worker_mod, msg: tuple):
+        kind = msg[0]
+        w = worker_mod._try_global_worker()
+        if w is None or not w.is_alive:
+            raise RuntimeError("driver runtime is down")
+        if kind == "actor_call":
+            _, actor_bin, method, args_bytes, num_returns = msg
+            from ray_tpu._private.ids import ActorID
+
+            runtime = w.actors.get(ActorID(actor_bin))
+            if runtime is None:
+                raise ValueError("actor no longer exists on its owner")
+            args, kwargs = pickle.loads(args_bytes)
+            refs = runtime.submit(method, args, kwargs, num_returns,
+                                  method)
+            # Resolve results locally; cross-driver handles get VALUES
+            # back (one round trip), not refs into a foreign store.
+            import ray_tpu
+
+            values = [ray_tpu.get(r, timeout=60.0) for r in refs]
+            return pickle.dumps(values, protocol=5)
+        if kind == "object_get":
+            _, oid_bin = msg
+            from ray_tpu._private.ids import ObjectID
+
+            serialized = w.store.get(ObjectID(oid_bin), timeout=30.0)
+            return serialized.to_bytes()
+        raise ValueError(f"unknown event {kind!r}")
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(0.5):
+            try:
+                with self._hb_lock:
+                    self._hb.send(("heartbeat",))
+                    self._check(self._hb.recv())
+            except Exception:  # noqa: BLE001 — head gone
+                return
+
+    def close(self):
+        self._stop.set()
+        for conn in (self._req, self._event, self._hb):
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -1,0 +1,263 @@
+"""Standalone control-plane service (the GCS-equivalent head process).
+
+Rebuild of the reference's global control service (reference roles:
+src/ray/gcs/gcs_server — the KV, actor directory, node membership +
+health-check, and object-location services every node talks to over RPC
+[unverified]). This is a real separate OS process speaking a socket RPC
+protocol (stdlib ``multiprocessing.connection`` — length-prefixed pickle
+with HMAC auth), so multiple independent driver processes form one
+logical cluster:
+
+- **KV**: cluster-global key/value (collectives, train/tune channels and
+  named state work ACROSS drivers once a head is attached).
+- **Actor directory**: named actors registered by one driver are callable
+  from another; calls relay head -> owning driver over that driver's
+  event channel, results return as object pulls.
+- **Object directory**: owners announce object ids; remote drivers pull
+  the serialized bytes through the head (ObjectManager-relay analogue).
+- **Membership + failure detection**: clients heartbeat; a monitor thread
+  expires silent clients and garbage-collects their directory entries,
+  so a crashed driver's named actors stop resolving instead of hanging.
+
+Run it with ``ray-tpu start --head`` or ``python -m
+ray_tpu._private.head_service``; drivers attach via
+``ray_tpu.init(address="host:port")``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from multiprocessing.connection import Connection, Listener
+from typing import Any, Dict, Optional, Tuple
+
+DEFAULT_PORT = 6380
+AUTHKEY = b"ray_tpu_head"  # localhost control plane; HMAC handshake only
+
+_HEARTBEAT_PERIOD_S = 0.5
+_CLIENT_TIMEOUT_S = 5.0
+
+
+class _Client:
+    def __init__(self, client_id: str):
+        self.client_id = client_id
+        self.last_seen = time.monotonic()
+        self.event_conn: Optional[Connection] = None
+        self.event_lock = threading.Lock()
+        self.alive = True
+
+
+class HeadService:
+    """The head process body: serve request connections, relay events."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        import os
+
+        if host not in ("127.0.0.1", "localhost", "::1") and not \
+                os.environ.get("RAY_TPU_INSECURE_BIND"):
+            # The protocol is pickle-over-socket with a source-public
+            # authkey: any peer that can connect gets code execution.
+            # Non-loopback binds need an explicit opt-in (and a network
+            # you trust end to end).
+            raise ValueError(
+                f"refusing to bind the head to {host!r}: the control "
+                f"protocol is only safe on loopback. Set "
+                f"RAY_TPU_INSECURE_BIND=1 to override on a trusted "
+                f"network.")
+        self._listener = Listener((host, port), authkey=AUTHKEY)
+        self.host, self.port = self._listener.address
+        self._lock = threading.Lock()
+        self._kv: Dict[bytes, bytes] = {}
+        self._clients: Dict[str, _Client] = {}
+        # name -> (client_id, actor_id_bin, class_name)
+        self._actors: Dict[Tuple[str, str], Tuple[str, bytes, str]] = {}
+        self._objects: Dict[bytes, str] = {}  # oid_bin -> owner client
+        self._stop = threading.Event()
+        self._threads = []
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="head-monitor")
+        self._monitor.start()
+
+    # ------------------------------------------------------------- serving
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: Connection):
+        try:
+            hello = conn.recv()  # ("hello", client_id, role)
+            _, client_id, role = hello
+            with self._lock:
+                c = self._clients.setdefault(client_id, _Client(client_id))
+                c.last_seen = time.monotonic()
+                c.alive = True
+            if role == "event":
+                # Head -> driver push channel; the driver holds the other
+                # end and serves relayed actor calls / object pulls.
+                c.event_conn = conn
+                conn.send(("ok", None))
+                return  # writes happen from relay paths
+            conn.send(("ok", None))
+            while not self._stop.is_set():
+                msg = conn.recv()
+                reply = self._dispatch(client_id, msg)
+                conn.send(reply)
+        except (EOFError, OSError):
+            pass
+        except Exception:  # noqa: BLE001 — connection error boundary
+            pass
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, client_id: str, msg: tuple):
+        kind = msg[0]
+        try:
+            with self._lock:
+                c = self._clients.get(client_id)
+                if c is not None:
+                    c.last_seen = time.monotonic()
+                    c.alive = True  # any traffic revives a marked-dead
+                    # client (its directory entries may already be GC'd)
+            if kind == "heartbeat":
+                return ("ok", None)
+            if kind == "kv_put":
+                _, key, value, overwrite = msg
+                with self._lock:
+                    if not overwrite and key in self._kv:
+                        return ("ok", False)
+                    self._kv[key] = value
+                return ("ok", True)
+            if kind == "kv_get":
+                with self._lock:
+                    return ("ok", self._kv.get(msg[1]))
+            if kind == "kv_del":
+                with self._lock:
+                    return ("ok", self._kv.pop(msg[1], None) is not None)
+            if kind == "kv_keys":
+                with self._lock:
+                    return ("ok", [k for k in self._kv
+                                   if k.startswith(msg[1])])
+            if kind == "actor_register":
+                _, namespace, name, actor_bin, class_name = msg
+                with self._lock:
+                    existing = self._actors.get((namespace, name))
+                    if existing is not None and self._is_alive(existing[0]):
+                        return ("err", ValueError(
+                            f"actor name {name!r} already taken in "
+                            f"namespace {namespace!r}"))
+                    self._actors[(namespace, name)] = (
+                        client_id, actor_bin, class_name)
+                return ("ok", None)
+            if kind == "actor_deregister":
+                _, namespace, name = msg
+                with self._lock:
+                    entry = self._actors.get((namespace, name))
+                    if entry is not None and entry[0] == client_id:
+                        del self._actors[(namespace, name)]
+                return ("ok", None)
+            if kind == "actor_lookup":
+                _, namespace, name = msg
+                with self._lock:
+                    entry = self._actors.get((namespace, name))
+                    if entry is None or not self._is_alive(entry[0]):
+                        return ("ok", None)
+                    return ("ok", entry)
+            if kind == "actor_call":
+                # Relay to the owning driver's event channel and wait.
+                _, owner_id, actor_bin, method, args_bytes, num_returns = msg
+                return self._relay(owner_id, (
+                    "actor_call", actor_bin, method, args_bytes,
+                    num_returns))
+            if kind == "object_announce":
+                with self._lock:
+                    self._objects[msg[1]] = client_id
+                return ("ok", None)
+            if kind == "object_pull":
+                _, oid_bin = msg
+                with self._lock:
+                    owner = self._objects.get(oid_bin)
+                if owner is None or not self._is_alive(owner):
+                    return ("ok", None)
+                return self._relay(owner, ("object_get", oid_bin))
+            if kind == "cluster_info":
+                with self._lock:
+                    return ("ok", {
+                        "clients": sorted(
+                            cid for cid, c in self._clients.items()
+                            if c.alive),
+                        "named_actors": sorted(
+                            n for (_, n) in self._actors),
+                        "num_objects": len(self._objects),
+                    })
+            return ("err", ValueError(f"unknown request {kind!r}"))
+        except Exception as exc:  # noqa: BLE001 — dispatch boundary
+            return ("err", exc)
+
+    def _is_alive(self, client_id: str) -> bool:
+        c = self._clients.get(client_id)
+        return c is not None and c.alive
+
+    def _relay(self, owner_id: str, event: tuple):
+        with self._lock:
+            c = self._clients.get(owner_id)
+        if c is None or not c.alive or c.event_conn is None:
+            return ("err", ConnectionError(
+                f"owner {owner_id!r} is not reachable"))
+        with c.event_lock:  # one in-flight relay per owner channel
+            try:
+                c.event_conn.send(event)
+                return c.event_conn.recv()
+            except (EOFError, OSError) as exc:
+                c.alive = False
+                return ("err", ConnectionError(
+                    f"owner {owner_id!r} died mid-call: {exc}"))
+
+    # ------------------------------------------------------------- monitor
+    def _monitor_loop(self):
+        while not self._stop.wait(_HEARTBEAT_PERIOD_S):
+            now = time.monotonic()
+            with self._lock:
+                for c in self._clients.values():
+                    if c.alive and now - c.last_seen > _CLIENT_TIMEOUT_S:
+                        c.alive = False  # failure detection
+                # GC directory entries owned by dead clients.
+                dead = {cid for cid, c in self._clients.items()
+                        if not c.alive}
+                for key in [k for k, v in self._actors.items()
+                            if v[0] in dead]:
+                    del self._actors[key]
+                for oid in [o for o, owner in self._objects.items()
+                            if owner in dead]:
+                    del self._objects[oid]
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = ap.parse_args(argv)
+    svc = HeadService(args.host, args.port)
+    # Port on stdout so launchers with --port 0 can discover it.
+    print(f"ray_tpu head listening on {svc.host}:{svc.port}", flush=True)
+    svc.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

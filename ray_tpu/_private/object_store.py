@@ -231,6 +231,23 @@ class ObjectStore:
                 for oid, e in self._entries.items()
             ]
 
+    def set_evict_callback(self, callback):
+        """Register a callback (cheap, non-reentrant) invoked with each
+        ObjectID as its entry is evicted; used by the process plane to
+        release the shm-resident copy. Multiple schedulers may share one
+        store (cluster sim), so callbacks accumulate."""
+        if not hasattr(self, "_evict_callbacks"):
+            self._evict_callbacks = []
+        self._evict_callbacks.append(callback)
+
+    def remove_evict_callback(self, callback):
+        """Unregister (scheduler shutdown) so dead schedulers don't stay
+        referenced and invoked on every eviction."""
+        try:
+            self._evict_callbacks.remove(callback)
+        except (AttributeError, ValueError):
+            pass
+
     def _maybe_evict_locked(self, object_id: ObjectID, entry: _Entry):
         if (
             entry.local_refs <= 0
@@ -246,6 +263,11 @@ class ObjectStore:
                 except OSError:
                     pass
             del self._entries[object_id]
+            for cb in getattr(self, "_evict_callbacks", ()):
+                try:
+                    cb(object_id)
+                except Exception:  # noqa: BLE001 — eviction must not fail
+                    pass
 
     def free(self, object_ids: List[ObjectID]):
         """Explicitly drop payloads (ray.internal.free parity)."""

@@ -123,6 +123,19 @@ class LocalScheduler:
         self._worker_pool = worker_pool
         self._shm_store = shm_store
         self._proc_running: Dict[TaskID, Any] = {}  # task -> WorkerProcess
+        # Plasma-parity data path: successful task outputs STAY in the shm
+        # store; a consumer task's ref args pass as shm keys the worker
+        # reads directly, so values don't round-trip through the driver.
+        # Entries release when the python store evicts the object.
+        # IMPORTANT: accessed with GIL-atomic dict ops ONLY, never under
+        # self._lock — the evict callback fires while the store holds ITS
+        # lock, and taking the scheduler lock there would close an ABBA
+        # cycle with _submit_native (scheduler lock -> store.contains).
+        self._shm_resident: Dict[Any, int] = {}  # ObjectID -> shm key
+        self._shm_key_pins: Dict[int, int] = {}  # key -> in-flight count
+        self._pin_lock = threading.Lock()  # leaf lock: nothing nests in it
+        if shm_store is not None:
+            store.set_evict_callback(self._release_shm_resident)
         # Native dependency queue: the C++ ready-ring replaces the python
         # callback chain for deps between normal tasks.
         self._dq = None
@@ -312,10 +325,18 @@ class LocalScheduler:
         start = time.monotonic()
         retry_spec = None
         try:
-            args, kwargs = _resolve_args(self._store, spec.args, spec.kwargs)
             if self._worker_pool is not None:
-                self._execute_in_process(spec, args, kwargs, cancelled_event)
+                pinned: list = []
+                try:
+                    args, kwargs = self._resolve_args_proc(
+                        spec.args, spec.kwargs, pinned)
+                    self._execute_in_process(spec, args, kwargs,
+                                             cancelled_event)
+                finally:
+                    self._unpin_shm_keys(pinned)
             else:
+                args, kwargs = _resolve_args(
+                    self._store, spec.args, spec.kwargs)
                 worker_mod._task_context.current_task_id = spec.task_id
                 worker_mod._task_context.task_name = spec.name
                 try:
@@ -346,6 +367,91 @@ class LocalScheduler:
                     self._backlog += 1
                     self._make_runnable_locked(retry_spec)
 
+    def _resolve_args_proc(self, args, kwargs, pinned: list):
+        """Arg resolution for the process plane: a ref whose value is
+        already resident in the shm store passes AS A SHM KEY — the worker
+        reads it directly, no driver round-trip (plasma-parity data path).
+        Everything else resolves to values like the thread path (raising
+        on upstream task errors). Keys used are appended to ``pinned``
+        (even on a mid-resolution raise) and must be unpinned by the
+        caller after dispatch."""
+        from ray_tpu._private.worker import ObjectRef, global_worker
+        from ray_tpu._private.worker_main import _ShmRef
+
+        ctx = global_worker().serialization_context
+
+        def _resolve(v):
+            if not isinstance(v, ObjectRef):
+                return v
+            key = self._shm_resident.get(v.object_id)
+            if key is not None:
+                with self._pin_lock:
+                    # Pin before the existence check: the flush valve
+                    # skips pinned keys, so a pinned+present key stays
+                    # valid until the task's dispatch completes.
+                    self._shm_key_pins[key] = (
+                        self._shm_key_pins.get(key, 0) + 1)
+                pinned.append(key)
+                if self._shm_store.contains(key):
+                    return _ShmRef(key)
+            serialized = self._store.get(v.object_id)
+            value = ctx.deserialize(serialized)
+            if isinstance(value, RayTaskError):
+                raise value.as_instanceof_cause()
+            return value
+
+        return (tuple(_resolve(a) for a in args),
+                {k: _resolve(v) for k, v in kwargs.items()})
+
+    def _unpin_shm_keys(self, pinned: list):
+        with self._pin_lock:
+            for key in pinned:
+                n = self._shm_key_pins.get(key, 0) - 1
+                if n <= 0:
+                    self._shm_key_pins.pop(key, None)
+                else:
+                    self._shm_key_pins[key] = n
+
+    def _maybe_flush_residents(self):
+        """Pressure valve: residency is a read-through cache (the python
+        store keeps the authoritative copy), so under shm pressure the
+        oldest unpinned half is safely dropped rather than starving new
+        results. Pinned keys (handed to an in-flight task as _ShmRef
+        args) are never flushed."""
+        try:
+            stats = self._shm_store.stats()
+        except Exception:  # noqa: BLE001 — store torn down
+            return
+        if stats["used"] <= stats["capacity"] * 0.6:
+            return
+        items = list(self._shm_resident.items())  # GIL-atomic snapshot
+        with self._pin_lock:
+            pinned = set(self._shm_key_pins)
+        victims = [(oid, key) for oid, key in items[:len(items) // 2]
+                   if key not in pinned]
+        for oid, key in victims:
+            self._shm_resident.pop(oid, None)
+            try:
+                self._shm_store.delete(key)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _release_shm_resident(self, object_id):
+        """Evict callback from the python store — runs UNDER the store's
+        lock, so only GIL-atomic dict ops and the leaf pin-lock here."""
+        key = self._shm_resident.pop(object_id, None)
+        if key is None or self._shm_store is None:
+            return
+        with self._pin_lock:
+            if key in self._shm_key_pins:
+                return  # in-flight arg: the python-store copy is gone but
+                # the shm bytes stay until the dispatch unpins (leaked
+                # only if the store evicts mid-dispatch — bounded).
+        try:
+            self._shm_store.delete(key)
+        except Exception:  # noqa: BLE001 — already reclaimed
+            pass
+
     def _execute_in_process(self, spec: TaskSpec, args, kwargs,
                             cancelled_event):
         """Ship the task to a leased worker process; outputs come back
@@ -375,7 +481,10 @@ class LocalScheduler:
             staged += st
             # A prior attempt may have died AFTER storing outputs but
             # BEFORE replying; clear any stale ret keys so the worker's
-            # put can't fail with "exists" on the retry.
+            # put can't fail with "exists" on the retry (and drop stale
+            # residency from a lineage re-execution of the same task).
+            for oid in spec.return_ids:
+                self._shm_resident.pop(oid, None)
             self._delete_shm_keys(ret_keys)
             with self._lock:
                 self._proc_running[spec.task_id] = w
@@ -390,7 +499,11 @@ class LocalScheduler:
             for oid, key in zip(spec.return_ids, ret_keys):
                 raw = bytes(self._shm_store.get(key))
                 self._store.put(oid, SerializedObject.from_bytes(raw))
-                self._shm_store.delete(key)
+                # Outputs STAY shm-resident so downstream process tasks
+                # read them in place; released when the python store
+                # evicts the object.
+                self._shm_resident[oid] = key
+            self._maybe_flush_residents()
         except BaseException:
             # Failure path: a crashed worker may have left some ret keys
             # behind — reclaim the shm slots.
@@ -467,6 +580,16 @@ class LocalScheduler:
 
     # ----------------------------------------------------------- cancel/misc
     def cancel(self, task_id: TaskID, force: bool = False):
+        """Cancel a task.
+
+        Queued (runnable) tasks are removed immediately; running tasks get
+        the cooperative cancel event (force=True additionally kills the
+        worker process so the task actually stops). A task still PENDING
+        in the native ready-ring is cancelled lazily: the ring has no
+        removal op, so the task is discarded when it pops — consumers of
+        its outputs observe TaskCancelledError at that point rather than
+        instantly (deferred-cancel semantics).
+        """
         with self._lock:
             self._cancelled.add(task_id)
             for i, spec in enumerate(self._runnable):
@@ -504,6 +627,8 @@ class LocalScheduler:
             return self._num_finished
 
     def shutdown(self):
+        if self._shm_store is not None:
+            self._store.remove_evict_callback(self._release_shm_resident)
         with self._lock:
             self._shutdown = True
             self._dispatch_cv.notify_all()

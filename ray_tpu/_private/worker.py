@@ -128,8 +128,17 @@ class Worker:
                  num_tpus: Optional[int] = None,
                  resources: Optional[Dict[str, float]] = None,
                  session_dir: Optional[str] = None,
-                 worker_mode: Optional[str] = None):
+                 worker_mode: Optional[str] = None,
+                 head_address: Optional[str] = None):
         self.is_alive = True
+        # Control plane: with an address, this driver joins the standalone
+        # head service (GCS analogue) — KV becomes cluster-global, named
+        # actors resolve across drivers, objects pull across drivers.
+        self.head_client = None
+        if head_address:
+            from ray_tpu._private.head_client import HeadClient
+
+            self.head_client = HeadClient(head_address)
         self.job_id = JobID.from_int(os.getpid() & 0xFFFFFFFF)
         self.worker_id = WorkerID.from_random()
         self.node_id = NodeID.from_random()
@@ -241,7 +250,30 @@ class Worker:
         self.store.put(oid, serialized)
         return ObjectRef(oid)
 
+    def announce_object(self, ref: ObjectRef):
+        """Publish this object's location to the head's object directory
+        so other drivers can pull it (ObjectManager-relay analogue)."""
+        if self.head_client is None:
+            raise RayTpuError(
+                "announce_object needs a head service "
+                "(ray_tpu.init(address=...))")
+        self.store.get(ref.object_id)  # must be materialized locally
+        self.head_client.object_announce(ref.object_id.binary())
+
+    def _maybe_pull_from_head(self, object_id: ObjectID) -> None:
+        """Cross-driver pull: only for objects this driver knows NOTHING
+        about (no store entry) — ordinary pending local results must not
+        pay a head round-trip on every get/wait."""
+        if self.head_client is None or self.store.contains(object_id):
+            return
+        raw = self.head_client.object_pull(object_id.binary())
+        if raw is not None:
+            from ray_tpu._private.serialization import SerializedObject
+
+            self.store.put(object_id, SerializedObject.from_bytes(raw))
+
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
+        self._maybe_pull_from_head(ref.object_id)
         if self.store.is_lost(ref.object_id):
             # Lineage reconstruction (cluster mode): re-execute producers.
             cluster = getattr(self, "cluster", None)
@@ -282,10 +314,17 @@ class Worker:
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
              timeout: Optional[float]):
+        if self.head_client is not None:
+            for oid in object_ids:
+                self._maybe_pull_from_head(oid)
         return self.store.wait(object_ids, num_returns, timeout)
 
     # -------------------------------------------------------- internal KV ---
+    # With a head attached the KV is cluster-global (GCS-KV semantics);
+    # standalone it is driver-local.
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        if self.head_client is not None:
+            return self.head_client.kv_put(key, value, overwrite)
         with self._kv_lock:
             if not overwrite and key in self._kv:
                 return False
@@ -293,14 +332,20 @@ class Worker:
             return True
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
+        if self.head_client is not None:
+            return self.head_client.kv_get(key)
         with self._kv_lock:
             return self._kv.get(key)
 
     def kv_del(self, key: bytes) -> bool:
+        if self.head_client is not None:
+            return self.head_client.kv_del(key)
         with self._kv_lock:
             return self._kv.pop(key, None) is not None
 
     def kv_keys(self, prefix: bytes = b"") -> List[bytes]:
+        if self.head_client is not None:
+            return self.head_client.kv_keys(prefix)
         with self._kv_lock:
             return [k for k in self._kv if k.startswith(prefix)]
 
@@ -329,6 +374,9 @@ class Worker:
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
             self.log_monitor = None
+        if self.head_client is not None:
+            self.head_client.close()
+            self.head_client = None
         if self.shm_store is not None:
             self.shm_store.close()
             self.shm_store = None
@@ -360,6 +408,7 @@ def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False, namespace: str = "default",
          worker_mode: Optional[str] = None,
+         address: Optional[str] = None,
          **_ignored) -> "Worker":
     global _global_worker
     with _init_lock:
@@ -371,9 +420,12 @@ def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
                 "to allow.")
         if _system_config:
             GlobalConfig.apply_system_config(_system_config)
+        if address in ("auto", "local"):
+            address = f"127.0.0.1:{6380}"
         _global_worker = Worker(num_cpus=num_cpus, num_tpus=num_tpus,
                                 resources=resources,
-                                worker_mode=worker_mode)
+                                worker_mode=worker_mode,
+                                head_address=address)
         _global_worker.namespace = namespace
         atexit.register(shutdown)
         return _global_worker

@@ -519,6 +519,18 @@ class _ActorRuntime:
             if self.use_process and self._proc is not None:
                 self._proc.kill()
             self._mailbox.put(_TERMINATE)
+        # Release the cluster-wide name so it can be reused while this
+        # driver lives.
+        reg = getattr(self, "_registered_name", None)
+        if reg is not None:
+            from ray_tpu._private.worker import _try_global_worker
+
+            w = _try_global_worker()
+            if w is not None and w.head_client is not None:
+                try:
+                    w.head_client.actor_deregister(*reg)
+                except Exception:  # noqa: BLE001 — head gone at teardown
+                    pass
 
     def join(self, timeout=None):
         self._thread.join(timeout)
@@ -684,6 +696,13 @@ class ActorClass:
         actor_id = ActorID.of(
             worker.job_id, worker.current_task_id(),
             worker.actor_counter.next())
+        if actor_name and worker.head_client is not None:
+            # Reserve the cluster-wide name BEFORE building the runtime:
+            # a rejection must not leave a live orphaned actor claiming
+            # the name locally.
+            worker.head_client.actor_register(
+                namespace, actor_name, actor_id.binary(),
+                self._cls.__name__)
         max_restarts = opts.get("max_restarts")
         if max_restarts is None:
             max_restarts = GlobalConfig.actor_max_restarts
@@ -699,6 +718,7 @@ class ActorClass:
         handle = ActorHandle(runtime)
         if actor_name:
             worker.named_actors[(namespace, actor_name)] = handle
+            runtime._registered_name = (namespace, actor_name)
         return handle
 
     def bind(self, *args, **kwargs):
@@ -720,10 +740,65 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
         return ClientActorHandle(worker.actor_named(name, namespace), name)
     ns = namespace or getattr(worker, "namespace", "default")
     handle = worker.named_actors.get((ns, name))
-    if handle is None or handle._runtime.dead:
-        raise ValueError(
-            f"no live actor named {name!r} in namespace {ns!r}")
-    return handle
+    if handle is not None and not handle._runtime.dead:
+        return handle
+    if worker.head_client is not None:
+        entry = worker.head_client.actor_lookup(ns, name)
+        if entry is not None:
+            owner_id, actor_bin, class_name = entry
+            if owner_id != worker.head_client.client_id:
+                return CrossDriverActorHandle(
+                    owner_id, actor_bin, class_name)
+    raise ValueError(
+        f"no live actor named {name!r} in namespace {ns!r}")
+
+
+class CrossDriverActorHandle:
+    """Handle to a named actor owned by ANOTHER driver attached to the
+    same head service. Method calls relay through the head to the owning
+    driver and resolve to VALUES (plain args only — ObjectRefs do not
+    cross drivers; pass values or announced objects)."""
+
+    def __init__(self, owner_id: str, actor_bin: bytes, class_name: str):
+        self._owner_id = owner_id
+        self._actor_bin = actor_bin
+        self._class_name = class_name
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _CrossDriverMethod(self, item)
+
+    def __repr__(self):
+        return (f"CrossDriverActorHandle({self._class_name}, "
+                f"owner={self._owner_id})")
+
+
+class _CrossDriverMethod:
+    def __init__(self, handle: CrossDriverActorHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker()
+        h = self._handle
+        oid = ObjectID.for_put(worker.current_task_id(),
+                               worker.put_counter.next())
+        ref = ObjectRef(oid)
+
+        def _run():
+            try:
+                values = worker.head_client.actor_call(
+                    h._owner_id, h._actor_bin, self._method, args, kwargs,
+                    1)
+                worker.store.put(
+                    oid, worker.serialization_context.serialize(values[0]))
+            except BaseException as exc:  # noqa: BLE001 — relay boundary
+                worker.store.put_error(oid, exc)
+
+        threading.Thread(target=_run, daemon=True,
+                         name="ray_tpu_cross_driver_call").start()
+        return ref
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):

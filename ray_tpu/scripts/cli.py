@@ -82,6 +82,22 @@ def cmd_job(args):
     raise SystemExit(f"unknown job command {args.job_cmd!r}")
 
 
+def cmd_start(args):
+    """Run the head control-plane service (reference: `ray start --head`).
+    Blocks; drivers attach with ray_tpu.init(address="host:port")."""
+    if not args.head:
+        raise SystemExit("only --head is supported (worker nodes attach "
+                         "via ray_tpu.init(address=...))")
+    from ray_tpu._private.head_service import HeadService
+
+    svc = HeadService(args.host, args.port)
+    print(f"ray_tpu head listening on {svc.host}:{svc.port}", flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        svc.shutdown()
+
+
 def cmd_logs(args):
     """List or print worker log files of a session (reference: `ray logs`).
     """
@@ -127,6 +143,11 @@ def main(argv=None):
     p.add_argument("job_cmd", choices=["submit"])
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_job)
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6380)
+    p.set_defaults(fn=cmd_start)
     p = sub.add_parser("logs")
     p.add_argument("filename", nargs="?", default=None)
     p.add_argument("--session", default=None)

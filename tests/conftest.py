@@ -14,6 +14,13 @@ import os
 # 8-device CPU backend regardless.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["RAY_TPU_PLATFORM"] = "cpu"
+# Persistent XLA compilation cache: compile-heavy tests (spmd transformer,
+# ring attention, wave executor) drop ~2.5x on warm runs, and the cache
+# survives across pytest processes.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

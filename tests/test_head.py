@@ -1,0 +1,166 @@
+"""Control-plane head service tests: a real separate head process, two
+driver processes, cluster-global KV, cross-driver named actors, object
+pulls, and dead-driver cleanup (reference model: GCS server tests —
+kv/actor directory/health-check behavior over RPC)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def head_proc():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", "0"],
+        stdout=subprocess.PIPE, text=True, env=dict(os.environ))
+    line = proc.stdout.readline()
+    address = line.strip().rsplit(" ", 1)[-1]
+    yield address
+    proc.kill()
+    proc.wait(timeout=5)
+
+
+_PEER = r"""
+import os, sys, time
+import ray_tpu
+
+address = sys.argv[1]
+ray_tpu.init(num_cpus=1, worker_mode="thread", address=address)
+w = ray_tpu._private.worker.global_worker()
+
+@ray_tpu.remote
+class Greeter:
+    def __init__(self):
+        self.n = 0
+    def hello(self, who):
+        self.n += 1
+        return f"hello {who} #{self.n}"
+
+g = Greeter.options(name="peer_greeter").remote()
+
+ref = ray_tpu.put({"payload": list(range(5))})
+ray_tpu.announce_object(ref)
+w.kv_put(b"peer/oid", ref.object_id.hex().encode())
+w.kv_put(b"peer/ready", b"1")
+
+deadline = time.time() + 30
+while time.time() < deadline:
+    if w.kv_get(b"peer/done") is not None:
+        break
+    time.sleep(0.05)
+ray_tpu.shutdown()
+"""
+
+
+@pytest.fixture
+def peer_driver(head_proc):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PEER, head_proc],
+        env=dict(os.environ))
+    yield head_proc, proc
+    proc.kill()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture
+def attached(head_proc):
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=2, worker_mode="thread",
+                          address=head_proc, ignore_reinit_error=True)
+    yield worker
+    ray_tpu.shutdown()
+
+
+def _wait_kv(worker, key, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = worker.kv_get(key)
+        if v is not None:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"kv key {key} never appeared")
+
+
+def test_kv_is_cluster_global(peer_driver, attached):
+    _wait_kv(attached, b"peer/ready")
+    attached.kv_put(b"driver_a/says", b"hi")
+    assert attached.kv_get(b"driver_a/says") == b"hi"
+    assert attached.kv_get(b"peer/ready") == b"1"
+    attached.kv_put(b"peer/done", b"1")
+
+
+def test_named_actor_resolves_across_drivers(peer_driver, attached):
+    _wait_kv(attached, b"peer/ready")
+    g = ray_tpu.get_actor("peer_greeter")
+    out = ray_tpu.get(g.hello.remote("driver_a"), timeout=30)
+    assert out == "hello driver_a #1"
+    out2 = ray_tpu.get(g.hello.remote("again"), timeout=30)
+    assert out2 == "hello again #2"  # state lives on the OWNING driver
+    attached.kv_put(b"peer/done", b"1")
+
+
+def test_object_pull_across_drivers(peer_driver, attached):
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.worker import ObjectRef
+
+    oid_hex = _wait_kv(attached, b"peer/oid").decode()
+    ref = ObjectRef(ObjectID.from_hex(oid_hex), _add_ref=False)
+    value = ray_tpu.get(ref, timeout=30)
+    assert value == {"payload": [0, 1, 2, 3, 4]}
+    attached.kv_put(b"peer/done", b"1")
+
+
+def test_dead_driver_directory_cleanup(peer_driver, attached):
+    head_address, proc = peer_driver
+    _wait_kv(attached, b"peer/ready")
+    assert ray_tpu.get_actor("peer_greeter") is not None
+    proc.kill()
+    proc.wait(timeout=5)
+    # Failure detection: after the heartbeat timeout the head garbage-
+    # collects the dead driver's directory entries.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            g = ray_tpu.get_actor("peer_greeter")
+        except ValueError:
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError("dead driver's named actor never expired")
+
+
+def test_cluster_info(peer_driver, attached):
+    _wait_kv(attached, b"peer/ready")
+    info = attached.head_client.cluster_info()
+    assert len(info["clients"]) >= 2
+    assert "peer_greeter" in info["named_actors"]
+    attached.kv_put(b"peer/done", b"1")
+
+
+def test_named_actor_name_reusable_after_kill(head_proc):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread", address=head_proc,
+                 ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        class A:
+            def __init__(self, v):
+                self.v = v
+
+            def get(self):
+                return self.v
+
+        a1 = A.options(name="reusable").remote(1)
+        assert ray_tpu.get(a1.get.remote()) == 1
+        ray_tpu.kill(a1)
+        # The head releases the name on kill: recreating must succeed.
+        a2 = A.options(name="reusable").remote(2)
+        assert ray_tpu.get(a2.get.remote()) == 2
+    finally:
+        ray_tpu.shutdown()

@@ -72,7 +72,14 @@ def test_timeline_chrome_trace():
         return 1
 
     ray_tpu.get([traced.remote() for _ in range(3)])
-    trace = get_timeline()
+    # get() returns when outputs land; the FINISHED event records a hair
+    # later on the executor thread — poll briefly.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        trace = get_timeline()
+        if len(trace) >= 3:
+            break
+        time.sleep(0.05)
     assert len(trace) >= 3
     ev = trace[0]
     assert ev["ph"] == "X" and "ts" in ev and "dur" in ev

@@ -301,3 +301,41 @@ def test_large_collective_between_process_actors(proc_runtime):
                        timeout=60)
     assert outs == [300_000.0 * 3, 300_000.0 * 3]
     col.destroy_collective_group("gbig")
+
+
+def test_ref_args_pass_through_shm_without_driver_copy(proc_runtime):
+    """A chained task's ref arg must ride the shm store directly: the
+    producer's output stays resident and the consumer receives a shm key,
+    not a driver-re-serialized value."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(500_000, dtype=np.float32)  # 2MB
+
+    @ray_tpu.remote
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=30)
+    sched = proc_runtime.scheduler
+    with sched._lock:
+        assert ref.object_id in sched._shm_resident  # output stayed in shm
+    expected = float(np.arange(500_000, dtype=np.float32).sum())
+    assert ray_tpu.get(consume.remote(ref), timeout=30) == expected
+    # The evict hook releases the shm copy (lineage pinning keeps task
+    # outputs resident in normal flow; the pressure valve bounds them).
+    key = sched._shm_resident.get(ref.object_id)
+    assert proc_runtime.shm_store.contains(key)
+    # get() returns before the dispatcher unpins the consumed arg — wait
+    # for the pin to drain, then release.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with sched._pin_lock:
+            if key not in sched._shm_key_pins:
+                break
+        time.sleep(0.02)
+    sched._release_shm_resident(ref.object_id)
+    assert ref.object_id not in sched._shm_resident
+    assert not proc_runtime.shm_store.contains(key)
