@@ -27,6 +27,11 @@ from ray_tpu._private.worker import (
     wait,
 )
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill
+from ray_tpu.remote_function import RemoteFunction, method, remote
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
 
 
 def announce_object(ref) -> None:
@@ -35,11 +40,6 @@ def announce_object(ref) -> None:
     from ray_tpu._private.worker import global_worker
 
     global_worker().announce_object(ref)
-from ray_tpu.remote_function import RemoteFunction, method, remote
-from ray_tpu.runtime_context import get_runtime_context
-from ray_tpu import exceptions
-
-__version__ = "0.1.0"
 
 __all__ = [
     "ActorClass",

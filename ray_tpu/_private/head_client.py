@@ -127,6 +127,14 @@ class HeadClient:
                 self._event.send(reply)
             except (EOFError, OSError):
                 return
+            except Exception:  # noqa: BLE001 — unpicklable error payload:
+                # MUST still reply or the head's relay blocks forever
+                # holding this owner's event lock.
+                try:
+                    self._event.send(("err", RuntimeError(
+                        f"unpicklable event reply: {reply!r:.200}")))
+                except (EOFError, OSError):
+                    return
 
     def _handle_event(self, worker_mod, msg: tuple):
         kind = msg[0]

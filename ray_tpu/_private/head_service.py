@@ -74,7 +74,6 @@ class HeadService:
         self._actors: Dict[Tuple[str, str], Tuple[str, bytes, str]] = {}
         self._objects: Dict[bytes, str] = {}  # oid_bin -> owner client
         self._stop = threading.Event()
-        self._threads = []
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
         self._monitor.start()
@@ -86,10 +85,9 @@ class HeadService:
                 conn = self._listener.accept()
             except (OSError, EOFError):
                 break
-            t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True)
-            t.start()
-            self._threads.append(t)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                daemon=True).start()
 
     def _serve_conn(self, conn: Connection):
         try:
@@ -236,6 +234,17 @@ class HeadService:
                 for oid in [o for o, owner in self._objects.items()
                             if owner in dead]:
                     del self._objects[oid]
+                # Prune long-dead clients entirely (a long-lived head
+                # serving churning drivers must not grow without bound).
+                for cid in [cid for cid, c in self._clients.items()
+                            if not c.alive
+                            and now - c.last_seen > 6 * _CLIENT_TIMEOUT_S]:
+                    c = self._clients.pop(cid)
+                    if c.event_conn is not None:
+                        try:
+                            c.event_conn.close()
+                        except OSError:
+                            pass
 
     def shutdown(self):
         self._stop.set()

@@ -425,12 +425,13 @@ class LocalScheduler:
         if stats["used"] <= stats["capacity"] * 0.6:
             return
         items = list(self._shm_resident.items())  # GIL-atomic snapshot
-        with self._pin_lock:
-            pinned = set(self._shm_key_pins)
-        victims = [(oid, key) for oid, key in items[:len(items) // 2]
-                   if key not in pinned]
-        for oid, key in victims:
-            self._shm_resident.pop(oid, None)
+        for oid, key in items[:len(items) // 2]:
+            with self._pin_lock:
+                # Pin check AT deletion time: a key pinned after any
+                # earlier snapshot must survive until its dispatch unpins.
+                if key in self._shm_key_pins:
+                    continue
+                self._shm_resident.pop(oid, None)
             try:
                 self._shm_store.delete(key)
             except Exception:  # noqa: BLE001

@@ -257,15 +257,21 @@ class Worker:
             raise RayTpuError(
                 "announce_object needs a head service "
                 "(ray_tpu.init(address=...))")
-        self.store.get(ref.object_id)  # must be materialized locally
+        if not self.store.is_ready(ref.object_id):
+            raise RayTpuError(
+                "announce_object: the object is not materialized locally "
+                "yet; ray_tpu.wait() on the ref first")
         self.head_client.object_announce(ref.object_id.binary())
 
     def _maybe_pull_from_head(self, object_id: ObjectID) -> None:
-        """Cross-driver pull: only for objects this driver knows NOTHING
-        about (no store entry) — ordinary pending local results must not
-        pay a head round-trip on every get/wait."""
-        if self.head_client is None or self.store.contains(object_id):
+        """Cross-driver pull for objects with no local value and no known
+        local producer. Refs of tasks this driver submitted resolve from
+        lineage without a head round-trip; cross-driver refs (whether they
+        arrived by pickle or were constructed from a hex id) pull once."""
+        if self.head_client is None or self.store.is_ready(object_id):
             return
+        if self.scheduler.lineage_for(object_id.task_id()) is not None:
+            return  # a local task will produce it
         raw = self.head_client.object_pull(object_id.binary())
         if raw is not None:
             from ray_tpu._private.serialization import SerializedObject
@@ -421,7 +427,9 @@ def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
         if _system_config:
             GlobalConfig.apply_system_config(_system_config)
         if address in ("auto", "local"):
-            address = f"127.0.0.1:{6380}"
+            from ray_tpu._private.head_service import DEFAULT_PORT
+
+            address = f"127.0.0.1:{DEFAULT_PORT}"
         _global_worker = Worker(num_cpus=num_cpus, num_tpus=num_tpus,
                                 resources=resources,
                                 worker_mode=worker_mode,

@@ -232,14 +232,35 @@ class WorkerPool:
         self._all: List[WorkerProcess] = []
         self._shutdown = False
         self._spawning = 0  # growth slots reserved but not yet spawned
+        self._base_workers = max(int(num_workers), 1)
         # Elastic cap: blocked workers (nested get() inside a task) hold
         # their lease, so the pool grows past the base size rather than
         # deadlocking — the reference's dynamic worker-start behavior.
         self._max_workers = max_workers or max(num_workers * 4, num_workers)
-        for _ in range(num_workers):
-            w = WorkerProcess(store, max_msg=max_msg, log_dir=log_dir)
-            self._all.append(w)
-            self._idle.put(w)
+        # Workers spawn LAZILY on first demand: booting the whole base
+        # pool up front serializes ~0.4s of interpreter startup per worker
+        # on the CPU that init()'s caller is about to use.
+
+    def _try_spawn(self, limit: int) -> Optional[WorkerProcess]:
+        """Reserve a slot under `limit` and spawn outside the lock."""
+        with self._lock:
+            if (self._shutdown
+                    or len(self._all) + self._spawning >= limit):
+                return None
+            self._spawning += 1
+        try:
+            fresh = WorkerProcess(self._store, max_msg=self._max_msg,
+                                  log_dir=self._log_dir)
+        except Exception:  # noqa: BLE001 — e.g. shm store full
+            fresh = None
+        with self._lock:
+            self._spawning -= 1
+            if fresh is not None and not self._shutdown:
+                self._all.append(fresh)
+                return fresh
+        if fresh is not None:  # raced shutdown
+            fresh.shutdown(timeout=0.1)
+        return None
 
     def lease(self, timeout: float = 60.0) -> WorkerProcess:
         import time as _time
@@ -247,33 +268,26 @@ class WorkerPool:
         deadline = _time.monotonic() + timeout
         while True:
             try:
+                w = self._idle.get_nowait()
+            except queue.Empty:
+                # Below base size: spawn immediately, no wait.
+                fresh = self._try_spawn(self._base_workers)
+                if fresh is not None:
+                    return fresh
+            else:
+                if w.alive():
+                    return w
+                self._replace(w)
+                continue
+            try:
                 w = self._idle.get(timeout=0.5)
             except queue.Empty:
-                with self._lock:
-                    # Reserve the growth slot under the lock so concurrent
-                    # leasers can't collectively overshoot max_workers.
-                    can_grow = (not self._shutdown
-                                and (len(self._all) + self._spawning
-                                     < self._max_workers))
-                    if can_grow:
-                        self._spawning += 1
-                if can_grow:
-                    try:
-                        # Spawn OUTSIDE the lock (process startup must not
-                        # stall concurrent leases) and degrade to waiting
-                        # if the shm store can't fit more channel arenas.
-                        fresh = WorkerProcess(self._store,
-                                              max_msg=self._max_msg,
-                                              log_dir=self._log_dir)
-                    except Exception:  # noqa: BLE001 — e.g. store full
-                        fresh = None
-                    with self._lock:
-                        self._spawning -= 1
-                        if fresh is not None and not self._shutdown:
-                            self._all.append(fresh)
-                            return fresh
-                    if fresh is not None:  # raced shutdown
-                        fresh.shutdown(timeout=0.1)
+                # Elastic growth past the base (blocked workers holding
+                # leases must not deadlock nested submissions); spawn
+                # failure (e.g. shm store full) degrades to waiting.
+                fresh = self._try_spawn(self._max_workers)
+                if fresh is not None:
+                    return fresh
                 if _time.monotonic() >= deadline:
                     raise WorkerPoolExhaustedError(
                         f"no idle worker within {timeout:.0f}s "

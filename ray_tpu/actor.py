@@ -707,13 +707,24 @@ class ActorClass:
         if max_restarts is None:
             max_restarts = GlobalConfig.actor_max_restarts
         max_concurrency = opts.get("max_concurrency")
-        runtime = _ActorRuntime(
-            actor_id, self._cls, args, kwargs,
-            max_concurrency=max_concurrency,
-            max_restarts=max_restarts,
-            name=self._cls.__name__,
-            actor_name=actor_name,
-        )
+        try:
+            runtime = _ActorRuntime(
+                actor_id, self._cls, args, kwargs,
+                max_concurrency=max_concurrency,
+                max_restarts=max_restarts,
+                name=self._cls.__name__,
+                actor_name=actor_name,
+            )
+        except BaseException:
+            if actor_name and worker.head_client is not None:
+                # Release the reserved cluster-wide name on construction
+                # failure, or retries fail "already taken" forever.
+                try:
+                    worker.head_client.actor_deregister(
+                        namespace, actor_name)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         worker.actors[actor_id] = runtime
         handle = ActorHandle(runtime)
         if actor_name:

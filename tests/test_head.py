@@ -110,7 +110,8 @@ def test_object_pull_across_drivers(peer_driver, attached):
     from ray_tpu._private.worker import ObjectRef
 
     oid_hex = _wait_kv(attached, b"peer/oid").decode()
-    ref = ObjectRef(ObjectID.from_hex(oid_hex), _add_ref=False)
+    # The natural construction (default ref counting) must pull too.
+    ref = ObjectRef(ObjectID.from_hex(oid_hex))
     value = ray_tpu.get(ref, timeout=30)
     assert value == {"payload": [0, 1, 2, 3, 4]}
     attached.kv_put(b"peer/done", b"1")

@@ -163,6 +163,12 @@ def test_cli_status_and_list(capsys):
     out = capsys.readouterr().out
     data = json.loads(out)
     assert "cluster_resources" in data and "tasks" in data
-    main(["list", "tasks", "--limit", "5"])
-    out = capsys.readouterr().out
+    # The FINISHED event lands a hair after get() returns — poll briefly.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        main(["list", "tasks", "--limit", "5"])
+        out = capsys.readouterr().out
+        if "FINISHED" in out:
+            break
+        time.sleep(0.05)
     assert "FINISHED" in out
