@@ -145,6 +145,10 @@ _D("worker_mode", str, "process",
    "store — the default, matching the reference's process-isolated "
    "workers) or 'thread' (in-process pool; used automatically when the "
    "native layer is unavailable).")
+_D("memory_monitor_threshold", float, 0.95,
+   "System memory-used fraction above which the monitor kills the "
+   "youngest running process task (OutOfMemoryError, retriable). "
+   "0 disables the monitor.")
 _D("worker_channel_bytes", int, 1024 * 1024,
    "Request/reply channel buffer size per worker process (4 channels per "
    "worker are resident in the shm store; larger blobs are staged as "

@@ -111,14 +111,24 @@ class HeadClient:
     # -------------------------------------------------------------- events
     def _event_loop(self):
         """Serve relayed work from other drivers against the local
-        runtime (the per-node agent role)."""
+        runtime (the per-node agent role). A dropped event channel (the
+        head pruned us while frozen) reconnects with a fresh hello, so
+        relays to this driver resume after revival."""
         from ray_tpu._private import worker as worker_mod
 
         while not self._stop.is_set():
             try:
                 msg = self._event.recv()
             except (EOFError, OSError):
-                return
+                if self._stop.is_set():
+                    return
+                try:
+                    self._event = _Connect(self.address, authkey=AUTHKEY)
+                    self._event.send(("hello", self.client_id, "event"))
+                    self._check(self._event.recv())
+                    continue
+                except Exception:  # noqa: BLE001 — head gone for real
+                    return
             try:
                 reply = ("ok", self._handle_event(worker_mod, msg))
             except Exception as exc:  # noqa: BLE001 — event boundary

@@ -118,11 +118,13 @@ class HeadService:
         kind = msg[0]
         try:
             with self._lock:
-                c = self._clients.get(client_id)
-                if c is not None:
-                    c.last_seen = time.monotonic()
-                    c.alive = True  # any traffic revives a marked-dead
-                    # client (its directory entries may already be GC'd)
+                # Any traffic revives a marked-dead (or even pruned)
+                # client — its directory entries may already be GC'd, but
+                # KV/lookup service resumes, and a reconnecting event
+                # channel re-enables relays.
+                c = self._clients.setdefault(client_id, _Client(client_id))
+                c.last_seen = time.monotonic()
+                c.alive = True
             if kind == "heartbeat":
                 return ("ok", None)
             if kind == "kv_put":

@@ -30,7 +30,7 @@ class _Entry:
     __slots__ = (
         "serialized", "error", "ready", "size", "spilled_path",
         "local_refs", "submitted_refs", "pinned_for_lineage", "callbacks",
-        "create_time", "lost",
+        "create_time", "lost", "local_producer",
     )
 
     def __init__(self):
@@ -45,6 +45,7 @@ class _Entry:
         self.callbacks: List[Callable[[], None]] = []
         self.create_time = time.monotonic()
         self.lost = False
+        self.local_producer = False  # a local task/actor will produce it
 
 
 class ObjectStore:
@@ -122,6 +123,18 @@ class ObjectStore:
         with self._cv:
             e = self._entries.get(object_id)
             return e is not None and e.ready
+
+    def mark_local_producer(self, object_id: ObjectID):
+        """A task/actor submitted in THIS driver will produce the object —
+        cross-driver pulls for it are pointless."""
+        with self._cv:
+            self._entries.setdefault(object_id, _Entry()
+                                     ).local_producer = True
+
+    def has_local_producer(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.local_producer
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._cv:

@@ -134,6 +134,9 @@ class LocalScheduler:
         self._shm_resident: Dict[Any, int] = {}  # ObjectID -> shm key
         self._shm_key_pins: Dict[int, int] = {}  # key -> in-flight count
         self._pin_lock = threading.Lock()  # leaf lock: nothing nests in it
+        # Tasks whose workers the memory monitor killed: their crash is
+        # reported as OutOfMemoryError, not a generic worker crash.
+        self._oom_killed: set = set()
         if shm_store is not None:
             store.set_evict_callback(self._release_shm_resident)
         # Native dependency queue: the C++ ready-ring replaces the python
@@ -314,11 +317,16 @@ class LocalScheduler:
 
         cancelled_event = threading.Event()
         with self._lock:
-            if spec.task_id in self._cancelled:
-                self._resources.release(spec.resources)
-                self._finish_cancelled(spec)
-                return
-            self._running[spec.task_id] = cancelled_event
+            cancelled_now = spec.task_id in self._cancelled
+            if not cancelled_now:
+                self._running[spec.task_id] = cancelled_event
+        if cancelled_now:
+            # OUTSIDE the lock: _finish_cancelled -> _finalize_native
+            # re-acquires it (self-deadlock on the non-reentrant lock
+            # otherwise — the teardown hang when cancel races dispatch).
+            self._resources.release(spec.resources)
+            self._finish_cancelled(spec)
+            return
 
         if self._events:
             self._events.record(spec.task_id, "RUNNING", name=spec.name)
@@ -349,6 +357,9 @@ class LocalScheduler:
                 self._events.record(
                     spec.task_id, "FINISHED", name=spec.name,
                     duration=time.monotonic() - start)
+            # A memory-monitor kill that raced this completion must not
+            # leave a stale marker to mislabel a later failure.
+            self._oom_killed.discard(spec.task_id)
             self._finalize_native(spec)
         except Exception as exc:  # noqa: BLE001 — task error boundary
             retry_spec = self._handle_failure(spec, exc)
@@ -427,15 +438,17 @@ class LocalScheduler:
         items = list(self._shm_resident.items())  # GIL-atomic snapshot
         for oid, key in items[:len(items) // 2]:
             with self._pin_lock:
-                # Pin check AT deletion time: a key pinned after any
-                # earlier snapshot must survive until its dispatch unpins.
+                # Pin check AND delete under the pin lock: resolvers pin
+                # before their contains() check, so a key observed
+                # unpinned here cannot acquire a new reader between the
+                # check and the delete.
                 if key in self._shm_key_pins:
                     continue
                 self._shm_resident.pop(oid, None)
-            try:
-                self._shm_store.delete(key)
-            except Exception:  # noqa: BLE001
-                pass
+                try:
+                    self._shm_store.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _release_shm_resident(self, object_id):
         """Evict callback from the python store — runs UNDER the store's
@@ -541,13 +554,19 @@ class LocalScheduler:
         # Worker-process death is a system failure: retriable by default,
         # like the reference's WorkerCrashedError semantics.
         from ray_tpu.exceptions import (
+            OutOfMemoryError,
             WorkerCrashedError,
             WorkerPoolExhaustedError,
         )
 
+        if spec.task_id in self._oom_killed:
+            self._oom_killed.discard(spec.task_id)
+            exc = OutOfMemoryError(
+                f"task {spec.name!r} was killed by the memory monitor "
+                f"(system memory pressure; youngest-task-first policy)")
         is_app_error = not isinstance(
-            exc, (SystemError, MemoryError, WorkerCrashedError,
-                  WorkerPoolExhaustedError))
+            exc, (SystemError, MemoryError, OutOfMemoryError,
+                  WorkerCrashedError, WorkerPoolExhaustedError))
         retriable = spec.attempt < spec.max_retries and (
             spec.retry_exceptions or not is_app_error
         )
@@ -564,8 +583,9 @@ class LocalScheduler:
                 scheduling_strategy=spec.scheduling_strategy,
                 attempt=spec.attempt + 1,
             )
-        if isinstance(exc, (TaskCancelledError, RayTaskError)):
-            error = exc  # pass dependency failures through unwrapped
+        if isinstance(exc, (TaskCancelledError, RayTaskError,
+                            OutOfMemoryError)):
+            error = exc  # typed system/dependency failures stay unwrapped
         else:
             error = RayTaskError.from_exception(spec.name, exc)
         for oid in spec.return_ids:

@@ -221,6 +221,14 @@ class Worker:
             task_events=self.task_events,
             worker_pool=self.worker_pool, shm_store=self.shm_store,
         )
+        self.memory_monitor = None
+        if (self.worker_pool is not None
+                and GlobalConfig.memory_monitor_threshold > 0):
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self.scheduler,
+                threshold_fraction=GlobalConfig.memory_monitor_threshold)
         self.submission_counter = _Counter()
         self.put_counter = _Counter()
         self.actor_counter = _Counter()
@@ -270,6 +278,8 @@ class Worker:
         arrived by pickle or were constructed from a hex id) pull once."""
         if self.head_client is None or self.store.is_ready(object_id):
             return
+        if self.store.has_local_producer(object_id):
+            return  # a local task/actor will produce it: never pullable
         if self.scheduler.lineage_for(object_id.task_id()) is not None:
             return  # a local task will produce it
         raw = self.head_client.object_pull(object_id.binary())
@@ -305,6 +315,8 @@ class Worker:
         dep_refs = _collect_refs(spec.args, spec.kwargs)
         for ref in dep_refs:
             self.store.add_submitted_ref(ref.object_id)
+        for oid in spec.return_ids:
+            self.store.mark_local_producer(oid)
         refs = [ObjectRef(oid) for oid in spec.return_ids]
         if dep_refs:
             def _release(_refs=dep_refs):
@@ -373,6 +385,9 @@ class Worker:
                 pass
         self.actors.clear()
         self.named_actors.clear()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
+            self.memory_monitor = None
         self.scheduler.shutdown()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()

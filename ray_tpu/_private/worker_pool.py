@@ -267,6 +267,13 @@ class WorkerPool:
 
         deadline = _time.monotonic() + timeout
         while True:
+            if self._shutdown:
+                # A straggler task leasing against a shut-down pool must
+                # fail NOW: with lazy spawning there is nothing idle and
+                # nothing will ever spawn, and an executor thread spinning
+                # out the full deadline blocks interpreter exit (the
+                # thread-pool atexit join).
+                raise WorkerPoolExhaustedError("worker pool is shut down")
             try:
                 w = self._idle.get_nowait()
             except queue.Empty:

@@ -473,6 +473,8 @@ class _ActorRuntime:
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
+        for oid in return_ids:
+            worker.store.mark_local_producer(oid)
         refs = [ObjectRef(oid) for oid in return_ids]
         if self.dead:
             err = ActorDiedError(self.actor_id,

@@ -136,6 +136,7 @@ def compile_jax_dag(
     fuse: bool = True,
     mesh=None,
     mesh_axis: Optional[str] = None,
+    frontier_width: Optional[int] = None,
 ) -> CompiledJaxDAG:
     """Lower a static DAG of jax-traceable FunctionNodes to one XLA program.
 
@@ -654,22 +655,27 @@ def compile_jax_dag(
             # ---- mesh-sharded dynamic frontier ------------------------------
             # Task ci is owned by shard ci // Cn (contiguous blocks, padded
             # to C_pad = Cn*n_sh). The in-degree vector and done mask stay
-            # replicated; each iteration a shard executes ready ∩ owned
-            # masked, and the frontier's outputs cross shards via one
-            # all_gather.
+            # replicated. Each iteration a shard executes up to F of its
+            # ready tasks (lowest index first via top_k) and the exchange
+            # ships ONLY those n_sh*F outputs + their ids — the
+            # sparse-frontier premise survives sharding: a 10k-task graph
+            # with a narrow ready set moves F payloads per shard per
+            # iteration, not its whole owned slice.
             from jax.sharding import PartitionSpec as P
 
             Cn = -(-C // n_sh)
             C_pad = Cn * n_sh
-            out_slots_pad = np.full(C_pad, scratch_slot, np.int32)
-            out_slots_pad[:C] = out_slots
+            F = frontier_width or min(Cn, 32)
+            F = max(1, min(int(F), Cn))
+            out_slots_ext = np.full(C_pad + 1, scratch_slot, np.int32)
+            out_slots_ext[:C] = out_slots  # index C_pad = dummy -> scratch
             indeg0_pad = np.zeros(C_pad, np.int32)
             indeg0_pad[:C] = indeg0
             done0_pad = np.zeros(C_pad, bool)
             done0_pad[C:] = True  # padding tasks are born finished
             ids_sharded = jnp.asarray(
                 np.arange(C_pad, dtype=np.int32).reshape(n_sh, Cn))
-            out_slots_pad_dev = jnp.asarray(out_slots_pad)
+            out_slots_ext_dev = jnp.asarray(out_slots_ext)
 
             def _sharded_dynamic(inputs, my_ids):
                 my_ids = my_ids[0]                       # [Cn]
@@ -686,17 +692,28 @@ def compile_jax_dag(
                 def body(state):
                     obj, indeg, done = state
                     ready = (indeg == 0) & ~done         # [C_pad]
-                    t_idx = jnp.where(ready[my_ids], my_ids, -1)
-                    outs = _compute_tasks(obj, t_idx)    # [Cn, *P]
-                    gathered = lax.all_gather(
-                        outs, mesh_axis, axis=0, tiled=True)  # [C_pad, *P]
-                    slots = jnp.where(ready, out_slots_pad_dev, scratch_slot)
-                    obj = obj.at[slots].set(gathered)
-                    done = done | ready
+                    mine = ready[my_ids]                 # [Cn]
+                    # Top-F ready owned tasks, lowest index first.
+                    scores = jnp.where(
+                        mine, -my_ids.astype(jnp.float32), -jnp.inf)
+                    _, sel = lax.top_k(scores, F)        # [F] positions
+                    chosen = my_ids[sel]                 # [F] global ids
+                    valid = mine[sel]
+                    t_idx = jnp.where(valid, chosen, -1)
+                    outs = _compute_tasks(obj, t_idx)    # [F, *P]
+                    g_outs = lax.all_gather(
+                        outs, mesh_axis, axis=0, tiled=True)  # [nF, *P]
+                    g_ids = lax.all_gather(
+                        jnp.where(valid, chosen, C_pad), mesh_axis,
+                        axis=0, tiled=True)              # [nF]
+                    obj = obj.at[out_slots_ext_dev[g_ids]].set(g_outs)
+                    fired = (jnp.zeros(C_pad + 1, bool).at[g_ids].set(True)
+                             )[:C_pad]
+                    done = done | fired
                     if e_src.shape[0]:
-                        fired = ready[e_src].astype(jnp.int32)
+                        hit = fired[e_src].astype(jnp.int32)
                         indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
-                            fired)
+                            hit)
                     return obj, indeg, done
 
                 obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
@@ -710,6 +727,9 @@ def compile_jax_dag(
 
             def program(inputs):
                 return sharded_fn(inputs, ids_sharded)
+
+            program.export_width = F
+            program.lanes_per_shard = Cn
 
     fn = program if mesh is not None else jax.jit(program)
     dag = CompiledJaxDAG(

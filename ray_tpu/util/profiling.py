@@ -1,0 +1,51 @@
+"""Device profiling (reference role: ray.timeline's device-side sibling —
+upstream integrates torch/NSight profilers; here the XLA profiler).
+
+``profile_trace`` captures an XLA/xplane trace (TensorBoard-loadable) of
+everything the device executes inside the block — compiled-DAG waves,
+train steps, collectives — complementing the host-side task timeline
+(``ray_tpu.timeline``). ``annotate`` nests named spans into that trace so
+framework phases (a wave, a pipeline stage) are attributable in the
+device view.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str,
+                  host_tracer_level: Optional[int] = None) -> Iterator[str]:
+    """Capture an xplane device+host trace into ``logdir``.
+
+    View with TensorBoard's profile plugin, or post-process the
+    ``*.xplane.pb`` files. Works on every backend (CPU tests included).
+    """
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span inside an active trace (TraceAnnotation passthrough)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def trace_files(logdir: str):
+    """The xplane protobuf files a capture produced under ``logdir``."""
+    out = []
+    for root, _dirs, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".xplane.pb"):
+                out.append(os.path.join(root, f))
+    return sorted(out)

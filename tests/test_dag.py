@@ -385,3 +385,22 @@ def test_jax_sharded_chain_skips_collective(ray_start_regular):
     assert sharded.export_width is not None
     assert sharded.export_width <= 1
     assert float(sharded.execute(0.0).get()) == 24.0
+
+
+def test_jax_sharded_dynamic_compacted_frontier(ray_start_regular):
+    """Dynamic sharded mode ships top-F ready tasks per iteration, not the
+    whole owned slice; parity must hold at frontier widths far below the
+    graph width."""
+    with InputNode() as inp:
+        layer = [inc.bind(inp) for _ in range(32)]
+        while len(layer) > 1:
+            layer = [add.bind(layer[i], layer[i + 1])
+                     for i in range(0, len(layer), 2)]
+        dag = layer[0]
+    single = dag.experimental_compile(backend="jax", dynamic=True)
+    narrow = dag.experimental_compile(
+        backend="jax", dynamic=True, mesh=_dag_mesh(), mesh_axis="dag",
+        frontier_width=2)
+    assert narrow.export_width == 2  # per-shard per-iteration exchange
+    assert float(narrow.execute(1.0).get()) == float(
+        single.execute(1.0).get())

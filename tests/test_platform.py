@@ -172,3 +172,19 @@ def test_cli_status_and_list(capsys):
             break
         time.sleep(0.05)
     assert "FINISHED" in out
+
+
+def test_device_profile_trace(tmp_path):
+    """xplane capture: a jitted computation inside profile_trace produces
+    TensorBoard-loadable trace files with our annotations."""
+    import jax.numpy as jnp
+
+    from ray_tpu.util.profiling import annotate, profile_trace, trace_files
+
+    logdir = str(tmp_path / "trace")
+    with profile_trace(logdir):
+        with annotate("ray_tpu_test_span"):
+            x = jnp.arange(1024.0)
+            (x * 2 + 1).sum().block_until_ready()
+    files = trace_files(logdir)
+    assert files, "no .xplane.pb produced"
