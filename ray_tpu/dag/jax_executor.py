@@ -331,8 +331,11 @@ def compile_jax_dag(
 
             def macro(*args):
                 x = head_fn(*args)
+                # Unroll amortizes per-iteration loop dispatch on fine
+                # chains (the op body is tiny by construction here).
                 return lax.scan(
-                    lambda c, _: (f(c), None), x, None, length=k)[0]
+                    lambda c, _: (f(c), None), x, None, length=k,
+                    unroll=min(2 * _UNROLL_LIMIT, k))[0]
         else:
             uniq: List[Callable] = []
             idx: Dict[int, int] = {}

@@ -404,3 +404,28 @@ def test_jax_sharded_dynamic_compacted_frontier(ray_start_regular):
     assert narrow.export_width == 2  # per-shard per-iteration exchange
     assert float(narrow.execute(1.0).get()) == float(
         single.execute(1.0).get())
+
+
+def test_actor_dag_channels_preserve_device_residency(ray_start_regular):
+    """In-driver actor-DAG channels pass values by reference (the
+    NCCL-channel role for same-host stages): a jax device array crosses
+    stages without serialization or host transfer."""
+    import jax.numpy as jnp
+
+    @ray_tpu.remote(max_concurrency=2)  # thread actor: shares the driver
+    class Stage:
+        def apply(self, x):
+            # Identity-preserving: return the SAME buffer object.
+            assert hasattr(x, "devices")  # still a jax Array, not numpy
+            return x
+
+    a, b = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        dag = b.apply.bind(a.apply.bind(inp))
+    compiled = dag.experimental_compile(backend="actor")
+    try:
+        arr = jnp.arange(1024.0)
+        out = compiled.execute(arr).get(timeout=15)
+        assert out is arr  # by-reference end to end: zero copies
+    finally:
+        compiled.teardown()
