@@ -15,11 +15,19 @@ from ray_tpu.util.scheduling_strategies import (
 )
 
 
-@pytest.fixture
-def cluster(ray_start_regular):
+@pytest.fixture(params=["process", "thread"])
+def cluster(request):
+    # The cluster suite runs under BOTH execution planes: the default
+    # process-isolated workers and the in-driver thread pool.
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=4, worker_mode=request.param)
+    if worker.worker_mode != request.param:
+        pytest.skip(f"plane {request.param!r} unavailable "
+                    f"(degraded to {worker.worker_mode!r})")
     c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
     yield c
     c.shutdown()
+    ray_tpu.shutdown()
 
 
 def test_tasks_run_across_nodes(cluster):
