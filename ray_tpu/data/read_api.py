@@ -99,7 +99,7 @@ def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
                 if os.path.isfile(f)
                 and (suffix is None or f.endswith(suffix))))
         elif any(c in p for c in "*?["):
-            out.extend(sorted(globlib.glob(p)))
+            out.extend(sorted(globlib.glob(p, recursive=True)))
         else:
             out.append(p)
     if not out:
@@ -107,20 +107,85 @@ def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
     return out
 
 
+def _hive_partition_values(file_path: str, root: Optional[str]):
+    """Hive-style ``key=value`` directory components of a file's path
+    (root-relative when a dataset root directory is known; otherwise every
+    path component — so globs and file lists keep their partitions)."""
+    dirname = os.path.dirname(os.path.abspath(file_path))
+    if root is not None:
+        dirname = os.path.relpath(dirname, os.path.abspath(root))
+    values = {}
+    for part in dirname.split(os.sep):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            values[k] = v
+    return values
+
+
+def _typed_partitions(per_file: List[dict]) -> List[dict]:
+    """Uniform partition schema across all files: the key UNION (missing
+    keys fill as empty strings — mixed-depth trees must concat), with
+    int/float inference when every file has the key and it parses
+    (`ds.partitioning`'s inferred-type contract)."""
+    keys = sorted(set().union(*per_file)) if per_file else []
+    out = [dict(v) for v in per_file]
+    for k in keys:
+        raw = [v.get(k) for v in per_file]
+        if any(r is None for r in raw):
+            cast = str  # mixed depth: keep strings, fill ""
+        else:
+            cast = str
+            for candidate in (int, float):
+                try:
+                    [candidate(r) for r in raw]
+                    cast = candidate
+                    break
+                except ValueError:
+                    continue
+        for v in out:
+            v[k] = cast(v[k]) if k in v else ""
+    return out
+
+
 def read_parquet(paths, *, columns: Optional[List[str]] = None,
                  **_opts) -> Dataset:
-    files = _expand_paths(paths, ".parquet")
+    """Read parquet files (file, glob, or partitioned directory tree).
 
-    def make_task(f):
+    Hive-style ``key=value`` path components materialize as partition
+    columns with int/float type inference — for directory roots, globs,
+    and explicit file lists alike (`ds.partitioning` analogue).
+    """
+    files = _expand_paths(paths, ".parquet")
+    roots = [paths] if isinstance(paths, str) else list(paths)
+    root = roots[0] if len(roots) == 1 and os.path.isdir(roots[0]) else None
+    per_file = _typed_partitions(
+        [_hive_partition_values(f, root) for f in files])
+
+    def make_task(f, part_values):
+        if columns is not None:
+            part_values = {k: v for k, v in part_values.items()
+                           if k in columns}
+            # [] (not None) when only partition columns are projected:
+            # None means read-everything to pyarrow.
+            file_columns = [c for c in columns if c not in part_values]
+        else:
+            file_columns = None
+
         def task() -> List[Block]:
             import pyarrow.parquet as pq
 
-            table = pq.read_table(f, columns=columns)
-            return [normalize_block(table)]
+            table = pq.read_table(f, columns=file_columns)
+            block = dict(normalize_block(table))
+            n = len(next(iter(block.values()))) if block else table.num_rows
+            for k, v in part_values.items():  # paths -> columns
+                block[k] = np.full(n, v)
+            return [block]
 
         return task
 
-    return _from_read_tasks("ReadParquet", [make_task(f) for f in files])
+    return _from_read_tasks(
+        "ReadParquet",
+        [make_task(f, pv) for f, pv in zip(files, per_file)])
 
 
 def read_csv(paths, **read_opts) -> Dataset:

@@ -201,3 +201,39 @@ def test_streaming_split_is_blockwise(ray_start_regular):
     assert seen == list(range(100))
     # Blockwise: shards hold whole blocks, no re-slicing of the dataset.
     assert sum(s.num_blocks() for s in shards) == 10
+
+
+def test_read_partitioned_parquet_hive_layout(ray_start_regular, tmp_path):
+    """Hive-style key=value directories read one task per file with the
+    partition keys materialized as columns."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import ray_tpu.data as rd
+
+    for year in ("2024", "2025"):
+        for city in ("sf", "nyc"):
+            d = tmp_path / f"year={year}" / f"city={city}"
+            d.mkdir(parents=True)
+            pq.write_table(
+                pa.table({"fare": [1.0 * int(year[-1]), 2.0]}),
+                d / "part-0.parquet")
+
+    ds = rd.read_parquet(str(tmp_path))
+    rows = ds.take_all()
+    assert len(rows) == 8
+    # Numeric partition keys infer int; strings stay strings.
+    assert {r["year"] for r in rows} == {2024, 2025}
+    assert {r["city"] for r in rows} == {"sf", "nyc"}
+    # Globs keep partitions too (whole-path key=value parsing).
+    globbed = rd.read_parquet(
+        str(tmp_path / "**" / "*.parquet")).take_all()
+    assert {r["city"] for r in globbed} == {"sf", "nyc"}
+    # Column projection mixes file + partition columns.
+    proj = rd.read_parquet(str(tmp_path), columns=["fare", "city"]
+                           ).take_all()
+    assert set(proj[0].keys()) == {"fare", "city"}
+    # Partition-aware aggregation end to end.
+    agg = (rd.read_parquet(str(tmp_path)).groupby("city")
+           .count().take_all())
+    assert {r["city"]: r["count()"] for r in agg} == {"sf": 4, "nyc": 4}
