@@ -149,6 +149,9 @@ _D("memory_monitor_threshold", float, 0.95,
    "System memory-used fraction above which the monitor kills the "
    "youngest running process task (OutOfMemoryError, retriable). "
    "0 disables the monitor.")
+_D("spill_backlog_factor", float, 4.0,
+   "Route tasks to remote node daemons when the local backlog exceeds "
+   "factor times num_cpus and a feasible node is less loaded.")
 _D("worker_channel_bytes", int, 1024 * 1024,
    "Request/reply channel buffer size per worker process (4 channels per "
    "worker are resident in the shm store; larger blobs are staged as "

@@ -1,12 +1,19 @@
-"""Driver-side connection to the head service (GCS client analogue).
+"""Driver/node-side connection to the head service (GCS client analogue).
 
-Each attached driver keeps two connections to the head process: a request
-channel for its own RPCs (KV, directories, relayed calls) and an event
-channel the head pushes work through — relayed actor calls from OTHER
-drivers and object pulls — served by a daemon thread against the local
-runtime. A heartbeat thread keeps the membership entry alive; silence
-past the head's timeout marks this driver dead and garbage-collects its
-directory entries (failure detection).
+Each attached process keeps three authenticated framed-msgpack connections
+to the head: a request channel for its own RPCs (KV, directories, relayed
+calls), a heartbeat channel (liveness must not starve behind a long
+relayed RPC), and a multiplexed event channel the head pushes work
+through — relayed actor calls from other drivers, chunked object reads,
+task pushes (node role) and task completions (driver role) — served by a
+small thread pool against the local runtime.
+
+All three channels **reconnect-and-resume**: if the head restarts (it
+persists its directories — GCS FT), the heartbeat loop re-dials until the
+head answers, requests retry once over a fresh connection, and the event
+channel re-issues its hello so relays resume. Directory entries this
+client owns survive in the head's append-log; re-registration is not
+required.
 """
 
 from __future__ import annotations
@@ -14,10 +21,18 @@ from __future__ import annotations
 import pickle
 import threading
 import uuid
-from multiprocessing.connection import Client as _Connect
-from typing import Any, Optional, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
 
-from ray_tpu._private.head_service import AUTHKEY
+from ray_tpu._private.transport import (
+    FramedConnection,
+    connect,
+    exc_to_wire,
+    resolve_token,
+    wire_to_exc,
+)
+
+_PULL_CHUNK = 4 * 1024 * 1024  # object pulls ride 4 MiB frames
 
 
 def parse_address(address: str) -> Tuple[str, int]:
@@ -26,25 +41,25 @@ def parse_address(address: str) -> Tuple[str, int]:
 
 
 class HeadClient:
-    def __init__(self, address: str,
-                 client_id: Optional[str] = None):
+    def __init__(self, address: str, client_id: Optional[str] = None,
+                 token: Optional[str] = None):
         self.address = parse_address(address)
+        self.token = resolve_token(self.address[1], token)
         self.client_id = client_id or f"driver-{uuid.uuid4().hex[:8]}"
-        self._req = _Connect(self.address, authkey=AUTHKEY)
-        self._req.send(("hello", self.client_id, "request"))
-        self._check(self._req.recv())
-        self._event = _Connect(self.address, authkey=AUTHKEY)
-        self._event.send(("hello", self.client_id, "event"))
-        self._check(self._event.recv())
-        # Dedicated heartbeat connection: a long relayed RPC on the
-        # request channel must not starve liveness (the head would mark
-        # this driver dead mid-call and GC its directory entries).
-        self._hb = _Connect(self.address, authkey=AUTHKEY)
-        self._hb.send(("hello", self.client_id, "request"))
-        self._check(self._hb.recv())
-        self._hb_lock = threading.Lock()
+        # Extension points: the node daemon serves task pushes; the
+        # driver's remote router consumes task completions.
+        self.handlers: Dict[str, Callable[[tuple], Any]] = {}
+        self.status_fn: Optional[Callable[[], dict]] = None
         self._lock = threading.Lock()
+        self._hb_lock = threading.Lock()
+        self._reconnect_lock = threading.Lock()
         self._stop = threading.Event()
+        self._req = self._dial("request")
+        self._hb = self._dial("request")
+        self._event = self._dial("event")
+        self._pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="ray_tpu_head_event")
+        self._serialized_cache: Dict[bytes, bytes] = {}  # chunked reads
         self._event_thread = threading.Thread(
             target=self._event_loop, daemon=True,
             name="ray_tpu_head_events")
@@ -54,17 +69,40 @@ class HeadClient:
             name="ray_tpu_head_heartbeat")
         self._hb_thread.start()
 
+    # ------------------------------------------------------------ plumbing
+    def _dial(self, role: str) -> FramedConnection:
+        conn = connect(*self.address, self.token)
+        conn.send(("hello", self.client_id, role))
+        self._check(conn.recv())
+        return conn
+
     @staticmethod
     def _check(reply):
         status, value = reply
         if status == "err":
-            raise value
+            raise wire_to_exc(value) if isinstance(value, dict) else \
+                RuntimeError(str(value))
         return value
 
     def _request(self, msg: tuple):
-        with self._lock:
-            self._req.send(msg)
-            return self._check(self._req.recv())
+        try:
+            with self._lock:
+                self._req.send(msg)
+                return self._check(self._req.recv())
+        except (EOFError, OSError, ConnectionError):
+            if self._stop.is_set():
+                raise
+            # One reconnect-and-retry: covers a restarted head (FT) and
+            # transient socket death. Non-idempotent ops here are put-style
+            # (last-write-wins) so the retry is safe.
+            with self._lock:
+                try:
+                    self._req.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._req = self._dial("request")
+                self._req.send(msg)
+                return self._check(self._req.recv())
 
     # ------------------------------------------------------------------ kv
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True):
@@ -77,7 +115,7 @@ class HeadClient:
         return self._request(("kv_del", key))
 
     def kv_keys(self, prefix: bytes = b""):
-        return self._request(("kv_keys", prefix))
+        return list(self._request(("kv_keys", prefix)))
 
     # -------------------------------------------------------------- actors
     def actor_register(self, namespace: str, name: str, actor_bin: bytes,
@@ -102,57 +140,115 @@ class HeadClient:
     def object_announce(self, oid_bin: bytes):
         return self._request(("object_announce", oid_bin))
 
-    def object_pull(self, oid_bin: bytes):
-        return self._request(("object_pull", oid_bin))
+    def object_pull(self, oid_bin: bytes) -> Optional[bytes]:
+        """Pull a remote object's serialized bytes in bounded chunks
+        (ObjectManager chunked-transfer analogue). Returns None when no
+        live owner is known."""
+        size = self._request(("object_meta", oid_bin))
+        if size is None:
+            return None
+        parts = []
+        offset = 0
+        while offset < size:
+            length = min(_PULL_CHUNK, size - offset)
+            chunk = self._request(("object_chunk", oid_bin, offset, length))
+            if chunk is None:
+                return None  # owner died mid-pull
+            parts.append(chunk)
+            offset += len(chunk)
+        return b"".join(parts)
+
+    # --------------------------------------------------------------- nodes
+    def node_register(self, node_id: str, resources: Dict[str, float]):
+        return self._request(("node_register", node_id, dict(resources)))
+
+    def node_list(self):
+        return [dict(n) for n in self._request(("node_list",))]
+
+    def task_push(self, target_client: str, payload: bytes):
+        return self._request(("task_push", target_client, payload))
+
+    def task_done(self, driver_id: str, oid_bins, payload: bytes):
+        return self._request(
+            ("task_done", driver_id, tuple(oid_bins), payload))
 
     def cluster_info(self) -> dict:
-        return self._request(("cluster_info",))
+        return dict(self._request(("cluster_info",)))
 
     # -------------------------------------------------------------- events
     def _event_loop(self):
-        """Serve relayed work from other drivers against the local
-        runtime (the per-node agent role). A dropped event channel (the
-        head pruned us while frozen) reconnects with a fresh hello, so
-        relays to this driver resume after revival."""
-        from ray_tpu._private import worker as worker_mod
-
+        """Serve relayed work from the head (the per-node agent role).
+        Multiplexed: requests carry ids and are answered out of order from
+        a thread pool, so a slow actor call cannot block object reads. A
+        dropped event channel reconnects with a fresh hello (head pruned
+        us / head restarted), so relays resume after revival."""
         while not self._stop.is_set():
             try:
                 msg = self._event.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, ValueError):
                 if self._stop.is_set():
                     return
-                try:
-                    self._event = _Connect(self.address, authkey=AUTHKEY)
-                    self._event.send(("hello", self.client_id, "event"))
-                    self._check(self._event.recv())
-                    continue
-                except Exception:  # noqa: BLE001 — head gone for real
+                if not self._reconnect_event():
                     return
-            try:
-                reply = ("ok", self._handle_event(worker_mod, msg))
-            except Exception as exc:  # noqa: BLE001 — event boundary
-                reply = ("err", exc)
-            try:
-                self._event.send(reply)
-            except (EOFError, OSError):
-                return
-            except Exception:  # noqa: BLE001 — unpicklable error payload:
-                # MUST still reply or the head's relay blocks forever
-                # holding this owner's event lock.
-                try:
-                    self._event.send(("err", RuntimeError(
-                        f"unpicklable event reply: {reply!r:.200}")))
-                except (EOFError, OSError):
-                    return
+                continue
+            if msg[0] != "req":
+                continue
+            rid, event = msg[1], msg[2:]
+            self._pool.submit(self._serve_event, rid, event)
 
-    def _handle_event(self, worker_mod, msg: tuple):
-        kind = msg[0]
+    def _reconnect_event(self) -> bool:
+        import time as _time
+
+        deadline = _time.monotonic() + 30.0
+        while not self._stop.is_set() and _time.monotonic() < deadline:
+            try:
+                self._event = self._dial("event")
+                return True
+            except Exception:  # noqa: BLE001 — head not back yet
+                _time.sleep(0.3)
+        return False
+
+    def _serve_event(self, rid: int, event: tuple):
+        try:
+            reply = ("rep", rid, "ok", self._handle_event(event))
+        except Exception as exc:  # noqa: BLE001 — event boundary
+            reply = ("rep", rid, "err", exc_to_wire(exc))
+        try:
+            self._event.send(reply)
+        except Exception:  # noqa: BLE001 — channel died; head will retry
+            pass
+
+    def _serialized_bytes(self, oid_bin: bytes) -> bytes:
+        """Serialized form of a locally-owned object, cached briefly so a
+        chunked pull doesn't re-serialize per chunk."""
+        cached = self._serialized_cache.get(oid_bin)
+        if cached is not None:
+            return cached
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.ids import ObjectID
+
         w = worker_mod._try_global_worker()
         if w is None or not w.is_alive:
             raise RuntimeError("driver runtime is down")
+        serialized = w.store.get(ObjectID(oid_bin), timeout=30.0)
+        raw = serialized.to_bytes()
+        if len(self._serialized_cache) > 4:
+            self._serialized_cache.clear()
+        self._serialized_cache[oid_bin] = raw
+        return raw
+
+    def _handle_event(self, event: tuple):
+        kind = event[0]
+        handler = self.handlers.get(kind)
+        if handler is not None:
+            return handler(event)
+        from ray_tpu._private import worker as worker_mod
+
         if kind == "actor_call":
-            _, actor_bin, method, args_bytes, num_returns = msg
+            w = worker_mod._try_global_worker()
+            if w is None or not w.is_alive:
+                raise RuntimeError("driver runtime is down")
+            _, actor_bin, method, args_bytes, num_returns = event
             from ray_tpu._private.ids import ActorID
 
             runtime = w.actors.get(ActorID(actor_bin))
@@ -168,26 +264,43 @@ class HeadClient:
             values = [ray_tpu.get(r, timeout=60.0) for r in refs]
             return pickle.dumps(values, protocol=5)
         if kind == "object_get":
-            _, oid_bin = msg
-            from ray_tpu._private.ids import ObjectID
-
-            serialized = w.store.get(ObjectID(oid_bin), timeout=30.0)
-            return serialized.to_bytes()
+            return self._serialized_bytes(event[1])
+        if kind == "object_meta":
+            return len(self._serialized_bytes(event[1]))
+        if kind == "object_chunk":
+            _, oid_bin, offset, length = event
+            return self._serialized_bytes(oid_bin)[offset:offset + length]
         raise ValueError(f"unknown event {kind!r}")
 
     def _heartbeat_loop(self):
         while not self._stop.wait(0.5):
+            status = None
+            if self.status_fn is not None:
+                try:
+                    status = self.status_fn()
+                except Exception:  # noqa: BLE001
+                    status = None
+            msg = ("heartbeat", status) if status else ("heartbeat",)
             try:
                 with self._hb_lock:
-                    self._hb.send(("heartbeat",))
+                    self._hb.send(msg)
                     self._check(self._hb.recv())
-            except Exception:  # noqa: BLE001 — head gone
-                return
+            except Exception:  # noqa: BLE001 — re-dial until head returns
+                with self._hb_lock:
+                    try:
+                        self._hb.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    try:
+                        self._hb = self._dial("request")
+                    except Exception:  # noqa: BLE001 — still down
+                        pass
 
     def close(self):
         self._stop.set()
+        self._pool.shutdown(wait=False, cancel_futures=True)
         for conn in (self._req, self._event, self._hb):
             try:
                 conn.close()
-            except OSError:
+            except Exception:  # noqa: BLE001
                 pass

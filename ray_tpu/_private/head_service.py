@@ -3,93 +3,268 @@
 Rebuild of the reference's global control service (reference roles:
 src/ray/gcs/gcs_server — the KV, actor directory, node membership +
 health-check, and object-location services every node talks to over RPC
-[unverified]). This is a real separate OS process speaking a socket RPC
-protocol (stdlib ``multiprocessing.connection`` — length-prefixed pickle
-with HMAC auth), so multiple independent driver processes form one
-logical cluster:
+[unverified]). A real separate OS process speaking the framed-msgpack
+transport (``_private/transport.py``): HMAC-authenticated with a
+per-cluster random token, no pickle in the envelope, legal to bind
+off-loopback. Services:
 
-- **KV**: cluster-global key/value (collectives, train/tune channels and
-  named state work ACROSS drivers once a head is attached).
+- **KV**: cluster-global key/value.
 - **Actor directory**: named actors registered by one driver are callable
   from another; calls relay head -> owning driver over that driver's
-  event channel, results return as object pulls.
+  multiplexed event channel.
 - **Object directory**: owners announce object ids; remote drivers pull
-  the serialized bytes through the head (ObjectManager-relay analogue).
-- **Membership + failure detection**: clients heartbeat; a monitor thread
-  expires silent clients and garbage-collects their directory entries,
-  so a crashed driver's named actors stop resolving instead of hanging.
+  the serialized bytes through the head in bounded chunks
+  (ObjectManager-relay analogue).
+- **Node membership**: node daemons (``node_daemon.py``) register their
+  resource specs; drivers list nodes and push tasks onto them
+  (raylet-registration analogue). Node heartbeats carry load so drivers
+  can spill to the least-loaded feasible node.
+- **Failure detection**: clients heartbeat; a monitor thread expires
+  silent clients and garbage-collects their directory entries.
+- **Fault tolerance**: KV, actor directory, object directory and node
+  registry are persisted to an append-log (``--state``); on restart the
+  head replays it and surviving clients reconnect-and-resume (GCS-FT
+  analogue, SURVEY §5.3).
 
-Run it with ``ray-tpu start --head`` or ``python -m
-ray_tpu._private.head_service``; drivers attach via
-``ray_tpu.init(address="host:port")``.
+Run ``ray-tpu start --head`` or ``python -m ray_tpu._private.head_service``;
+drivers attach via ``ray_tpu.init(address="host:port")``, nodes join via
+``ray-tpu start --address=host:port``.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import struct
 import threading
 import time
-from multiprocessing.connection import Connection, Listener
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private.transport import (
+    FramedConnection,
+    TokenListener,
+    exc_to_wire,
+    generate_token,
+    pack,
+    resolve_token,
+    unpack,
+    write_token,
+)
+
 DEFAULT_PORT = 6380
-AUTHKEY = b"ray_tpu_head"  # localhost control plane; HMAC handshake only
 
 _HEARTBEAT_PERIOD_S = 0.5
-_CLIENT_TIMEOUT_S = 5.0
+
+
+def _client_timeout_s() -> float:
+    return float(os.environ.get("RAY_TPU_HEAD_CLIENT_TIMEOUT_S", "5.0"))
+
+
+class _EventChannel:
+    """Head-side end of one client's event connection, multiplexed: many
+    in-flight relayed requests tagged with request ids, replies matched by
+    a reader thread. Replaces the one-in-flight-relay-per-owner lock."""
+
+    def __init__(self, conn: FramedConnection):
+        self.conn = conn
+        self.alive = True
+        self._rid = 0
+        self._lock = threading.Lock()
+        self._pending: Dict[int, list] = {}  # rid -> [Event, status, value]
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="head-event-reader")
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                msg = self.conn.recv()
+                if msg[0] != "rep":
+                    continue
+                _, rid, status, value = msg
+                with self._lock:
+                    slot = self._pending.pop(rid, None)
+                if slot is not None:
+                    slot[1], slot[2] = status, value
+                    slot[0].set()
+        except Exception:  # noqa: BLE001 — channel gone
+            self.fail_all("event channel closed")
+
+    def fail_all(self, why: str):
+        self.alive = False
+        with self._lock:
+            pending, self._pending = dict(self._pending), {}
+        for slot in pending.values():
+            slot[1] = "err"
+            slot[2] = {"type": "ConnectionError", "module": "builtins",
+                       "message": why}
+            slot[0].set()
+
+    def call(self, event: tuple, timeout: Optional[float] = None):
+        if not self.alive:
+            return ("err", {"type": "ConnectionError", "module": "builtins",
+                            "message": "owner event channel is down"})
+        slot = [threading.Event(), None, None]
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            self._pending[rid] = slot
+        try:
+            self.conn.send(("req", rid) + event)
+        except Exception as exc:  # noqa: BLE001
+            with self._lock:
+                self._pending.pop(rid, None)
+            self.fail_all(str(exc))
+            return ("err", exc_to_wire(ConnectionError(
+                f"owner died mid-call: {exc}")))
+        if not slot[0].wait(timeout):
+            with self._lock:
+                self._pending.pop(rid, None)
+            return ("err", {"type": "TimeoutError", "module": "builtins",
+                            "message": "relay timed out"})
+        return (slot[1], slot[2])
 
 
 class _Client:
     def __init__(self, client_id: str):
         self.client_id = client_id
         self.last_seen = time.monotonic()
-        self.event_conn: Optional[Connection] = None
-        self.event_lock = threading.Lock()
+        self.events: Optional[_EventChannel] = None
         self.alive = True
+        self.is_node = False
+        self.node_id: Optional[str] = None
+        self.resources: Dict[str, float] = {}
+        self.status: Dict[str, Any] = {}  # last heartbeat load report
+
+
+class _StateLog:
+    """Append-log persistence for the head's directories (GCS-FT role).
+
+    Records are length-prefixed msgpack tuples. Replay stops at the first
+    torn record (crash mid-write), which is safe: the log is replayed
+    before serving, so the lost tail is at most the final in-flight op.
+    """
+
+    _LEN = struct.Struct(">I")
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def append(self, record: tuple):
+        data = pack(record)
+        with self._lock:
+            self._f.write(self._LEN.pack(len(data)) + data)
+            self._f.flush()
+
+    @staticmethod
+    def replay(path: str):
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return
+        with f:
+            while True:
+                head = f.read(4)
+                if len(head) < 4:
+                    return
+                (length,) = _StateLog._LEN.unpack(head)
+                data = f.read(length)
+                if len(data) < length:
+                    return  # torn tail
+                try:
+                    yield unpack(data)
+                except Exception:  # noqa: BLE001 — corrupt record ends log
+                    return
+
+    def close(self):
+        with self._lock:
+            self._f.close()
 
 
 class HeadService:
     """The head process body: serve request connections, relay events."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
-        import os
-
-        if host not in ("127.0.0.1", "localhost", "::1") and not \
-                os.environ.get("RAY_TPU_INSECURE_BIND"):
-            # The protocol is pickle-over-socket with a source-public
-            # authkey: any peer that can connect gets code execution.
-            # Non-loopback binds need an explicit opt-in (and a network
-            # you trust end to end).
-            raise ValueError(
-                f"refusing to bind the head to {host!r}: the control "
-                f"protocol is only safe on loopback. Set "
-                f"RAY_TPU_INSECURE_BIND=1 to override on a trusted "
-                f"network.")
-        self._listener = Listener((host, port), authkey=AUTHKEY)
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
+                 token: Optional[str] = None,
+                 state_path: Optional[str] = None):
+        self._listener = TokenListener(host, port, None)
         self.host, self.port = self._listener.address
+        # Token resolution order: explicit > env > this port's existing
+        # token file (a restarted head MUST keep its token or surviving
+        # clients cannot re-authenticate — GCS-FT requirement) > fresh.
+        from ray_tpu._private.transport import read_token_file
+
+        token = (token or os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+                 or read_token_file(self.port) or generate_token())
+        self._listener.set_token(token)
+        self.token = token
+        self.token_file = write_token(self.port, token)
         self._lock = threading.Lock()
         self._kv: Dict[bytes, bytes] = {}
         self._clients: Dict[str, _Client] = {}
         # name -> (client_id, actor_id_bin, class_name)
         self._actors: Dict[Tuple[str, str], Tuple[str, bytes, str]] = {}
         self._objects: Dict[bytes, str] = {}  # oid_bin -> owner client
+        self._log: Optional[_StateLog] = None
+        if state_path:
+            self._restore(state_path)
+            self._log = _StateLog(state_path)
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
         self._monitor.start()
+
+    # -------------------------------------------------------------- FT/state
+    def _restore(self, state_path: str):
+        """Replay the append-log. Clients recorded in the log are revived
+        optimistically (alive, fresh last_seen): survivors reconnect and
+        heartbeat within the timeout window; truly-dead ones expire
+        through the normal monitor path and their entries GC."""
+        for rec in _StateLog.replay(state_path):
+            op = rec[0]
+            if op == "kv_put":
+                self._kv[rec[1]] = rec[2]
+            elif op == "kv_del":
+                self._kv.pop(rec[1], None)
+            elif op == "actor_register":
+                _, ns, name, cid, abin, cls = rec
+                self._actors[(ns, name)] = (cid, abin, cls)
+                self._clients.setdefault(cid, _Client(cid))
+            elif op == "actor_deregister":
+                self._actors.pop((rec[1], rec[2]), None)
+            elif op == "object_announce":
+                self._objects[rec[1]] = rec[2]
+                self._clients.setdefault(rec[2], _Client(rec[2]))
+            elif op == "object_forget":
+                self._objects.pop(rec[1], None)
+            elif op == "node_register":
+                _, cid, node_id, resources = rec
+                c = self._clients.setdefault(cid, _Client(cid))
+                c.is_node, c.node_id = True, node_id
+                c.resources = dict(resources)
+
+    def _persist(self, *record):
+        if self._log is not None:
+            try:
+                self._log.append(record)
+            except Exception:  # noqa: BLE001 — disk full: serve from memory
+                pass
 
     # ------------------------------------------------------------- serving
     def serve_forever(self):
         while not self._stop.is_set():
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
+            except OSError:
                 break
             threading.Thread(
                 target=self._serve_conn, args=(conn,),
                 daemon=True).start()
 
-    def _serve_conn(self, conn: Connection):
+    def _serve_conn(self, conn: FramedConnection):
         try:
             hello = conn.recv()  # ("hello", client_id, role)
             _, client_id, role = hello
@@ -98,17 +273,20 @@ class HeadService:
                 c.last_seen = time.monotonic()
                 c.alive = True
             if role == "event":
-                # Head -> driver push channel; the driver holds the other
-                # end and serves relayed actor calls / object pulls.
-                c.event_conn = conn
+                # Head -> client push channel (multiplexed): the client
+                # serves relayed actor calls / object reads / task pushes.
+                old = c.events
+                c.events = _EventChannel(conn)
+                if old is not None:
+                    old.fail_all("event channel replaced by reconnect")
                 conn.send(("ok", None))
-                return  # writes happen from relay paths
+                return  # reader thread owns the connection now
             conn.send(("ok", None))
             while not self._stop.is_set():
                 msg = conn.recv()
                 reply = self._dispatch(client_id, msg)
                 conn.send(reply)
-        except (EOFError, OSError):
+        except (EOFError, OSError, ValueError):
             pass
         except Exception:  # noqa: BLE001 — connection error boundary
             pass
@@ -126,6 +304,9 @@ class HeadService:
                 c.last_seen = time.monotonic()
                 c.alive = True
             if kind == "heartbeat":
+                if len(msg) > 1 and isinstance(msg[1], dict):
+                    with self._lock:
+                        c.status = msg[1]
                 return ("ok", None)
             if kind == "kv_put":
                 _, key, value, overwrite = msg
@@ -133,13 +314,17 @@ class HeadService:
                     if not overwrite and key in self._kv:
                         return ("ok", False)
                     self._kv[key] = value
+                self._persist("kv_put", key, value)
                 return ("ok", True)
             if kind == "kv_get":
                 with self._lock:
                     return ("ok", self._kv.get(msg[1]))
             if kind == "kv_del":
                 with self._lock:
-                    return ("ok", self._kv.pop(msg[1], None) is not None)
+                    existed = self._kv.pop(msg[1], None) is not None
+                if existed:
+                    self._persist("kv_del", msg[1])
+                return ("ok", existed)
             if kind == "kv_keys":
                 with self._lock:
                     return ("ok", [k for k in self._kv
@@ -149,11 +334,13 @@ class HeadService:
                 with self._lock:
                     existing = self._actors.get((namespace, name))
                     if existing is not None and self._is_alive(existing[0]):
-                        return ("err", ValueError(
+                        return ("err", exc_to_wire(ValueError(
                             f"actor name {name!r} already taken in "
-                            f"namespace {namespace!r}"))
+                            f"namespace {namespace!r}")))
                     self._actors[(namespace, name)] = (
                         client_id, actor_bin, class_name)
+                self._persist("actor_register", namespace, name, client_id,
+                              actor_bin, class_name)
                 return ("ok", None)
             if kind == "actor_deregister":
                 _, namespace, name = msg
@@ -161,6 +348,7 @@ class HeadService:
                     entry = self._actors.get((namespace, name))
                     if entry is not None and entry[0] == client_id:
                         del self._actors[(namespace, name)]
+                        self._persist("actor_deregister", namespace, name)
                 return ("ok", None)
             if kind == "actor_lookup":
                 _, namespace, name = msg
@@ -178,90 +366,151 @@ class HeadService:
             if kind == "object_announce":
                 with self._lock:
                     self._objects[msg[1]] = client_id
+                self._persist("object_announce", msg[1], client_id)
                 return ("ok", None)
             if kind == "object_pull":
                 _, oid_bin = msg
-                with self._lock:
-                    owner = self._objects.get(oid_bin)
-                if owner is None or not self._is_alive(owner):
+                owner = self._object_owner(oid_bin)
+                if owner is None:
                     return ("ok", None)
                 return self._relay(owner, ("object_get", oid_bin))
+            if kind == "object_meta":
+                _, oid_bin = msg
+                owner = self._object_owner(oid_bin)
+                if owner is None:
+                    return ("ok", None)
+                return self._relay(owner, ("object_meta", oid_bin))
+            if kind == "object_chunk":
+                _, oid_bin, offset, length = msg
+                owner = self._object_owner(oid_bin)
+                if owner is None:
+                    return ("ok", None)
+                return self._relay(
+                    owner, ("object_chunk", oid_bin, offset, length))
+            if kind == "node_register":
+                _, node_id, resources = msg
+                with self._lock:
+                    c.is_node = True
+                    c.node_id = node_id
+                    c.resources = dict(resources)
+                self._persist("node_register", client_id, node_id,
+                              dict(resources))
+                return ("ok", None)
+            if kind == "node_list":
+                with self._lock:
+                    return ("ok", [
+                        {"client_id": cl.client_id, "node_id": cl.node_id,
+                         "resources": cl.resources, "alive": cl.alive,
+                         "status": cl.status}
+                        for cl in self._clients.values() if cl.is_node])
+            if kind == "task_push":
+                _, target_client, payload = msg
+                return self._relay(target_client, ("task_push", payload))
+            if kind == "task_done":
+                # Node -> head -> submitting driver. Record result object
+                # locations first so the driver's pull finds an owner even
+                # if it races the relay.
+                _, driver_id, oid_bins, payload = msg
+                with self._lock:
+                    for ob in oid_bins:
+                        self._objects[ob] = client_id
+                for ob in oid_bins:
+                    self._persist("object_announce", ob, client_id)
+                return self._relay(driver_id, ("task_done", payload),
+                                   timeout=30.0)
             if kind == "cluster_info":
                 with self._lock:
                     return ("ok", {
                         "clients": sorted(
-                            cid for cid, c in self._clients.items()
-                            if c.alive),
+                            cid for cid, cl in self._clients.items()
+                            if cl.alive),
+                        "nodes": sorted(
+                            cl.node_id for cl in self._clients.values()
+                            if cl.is_node and cl.alive),
                         "named_actors": sorted(
                             n for (_, n) in self._actors),
                         "num_objects": len(self._objects),
                     })
-            return ("err", ValueError(f"unknown request {kind!r}"))
+            return ("err", exc_to_wire(ValueError(
+                f"unknown request {kind!r}")))
         except Exception as exc:  # noqa: BLE001 — dispatch boundary
-            return ("err", exc)
+            return ("err", exc_to_wire(exc))
+
+    def _object_owner(self, oid_bin: bytes) -> Optional[str]:
+        with self._lock:
+            owner = self._objects.get(oid_bin)
+        if owner is None or not self._is_alive(owner):
+            return None
+        return owner
 
     def _is_alive(self, client_id: str) -> bool:
         c = self._clients.get(client_id)
         return c is not None and c.alive
 
-    def _relay(self, owner_id: str, event: tuple):
+    def _relay(self, owner_id: str, event: tuple,
+               timeout: Optional[float] = None):
         with self._lock:
             c = self._clients.get(owner_id)
-        if c is None or not c.alive or c.event_conn is None:
-            return ("err", ConnectionError(
-                f"owner {owner_id!r} is not reachable"))
-        with c.event_lock:  # one in-flight relay per owner channel
-            try:
-                c.event_conn.send(event)
-                return c.event_conn.recv()
-            except (EOFError, OSError) as exc:
-                c.alive = False
-                return ("err", ConnectionError(
-                    f"owner {owner_id!r} died mid-call: {exc}"))
+            events = c.events if c is not None else None
+        if c is None or not c.alive or events is None or not events.alive:
+            return ("err", exc_to_wire(ConnectionError(
+                f"owner {owner_id!r} is not reachable")))
+        return events.call(event, timeout=timeout)
 
     # ------------------------------------------------------------- monitor
     def _monitor_loop(self):
+        timeout_s = _client_timeout_s()
         while not self._stop.wait(_HEARTBEAT_PERIOD_S):
             now = time.monotonic()
             with self._lock:
                 for c in self._clients.values():
-                    if c.alive and now - c.last_seen > _CLIENT_TIMEOUT_S:
+                    if c.alive and now - c.last_seen > timeout_s:
                         c.alive = False  # failure detection
                 # GC directory entries owned by dead clients.
                 dead = {cid for cid, c in self._clients.items()
                         if not c.alive}
-                for key in [k for k, v in self._actors.items()
-                            if v[0] in dead]:
+                dropped_actors = [k for k, v in self._actors.items()
+                                  if v[0] in dead]
+                for key in dropped_actors:
                     del self._actors[key]
-                for oid in [o for o, owner in self._objects.items()
-                            if owner in dead]:
+                dropped_objects = [o for o, owner in self._objects.items()
+                                   if owner in dead]
+                for oid in dropped_objects:
                     del self._objects[oid]
                 # Prune long-dead clients entirely (a long-lived head
                 # serving churning drivers must not grow without bound).
                 for cid in [cid for cid, c in self._clients.items()
                             if not c.alive
-                            and now - c.last_seen > 6 * _CLIENT_TIMEOUT_S]:
+                            and now - c.last_seen > 6 * timeout_s]:
                     c = self._clients.pop(cid)
-                    if c.event_conn is not None:
+                    if c.events is not None:
+                        c.events.fail_all("client pruned")
                         try:
-                            c.event_conn.close()
+                            c.events.conn.close()
                         except OSError:
                             pass
+            for ns, name in dropped_actors:
+                self._persist("actor_deregister", ns, name)
+            for oid in dropped_objects:
+                self._persist("object_forget", oid)
 
     def shutdown(self):
         self._stop.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        self._listener.close()
+        if self._log is not None:
+            self._log.close()
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--state", default=None,
+                    help="append-log path for head fault tolerance")
+    ap.add_argument("--token", default=None)
     args = ap.parse_args(argv)
-    svc = HeadService(args.host, args.port)
+    svc = HeadService(args.host, args.port, token=args.token,
+                      state_path=args.state)
     # Port on stdout so launchers with --port 0 can discover it.
     print(f"ray_tpu head listening on {svc.host}:{svc.port}", flush=True)
     svc.serve_forever()

@@ -1,0 +1,255 @@
+"""Cluster control-plane transport: framed msgpack over TCP with HMAC auth.
+
+Rebuild of the reference's RPC substrate role (reference: src/ray/rpc/ —
+gRPC channels carrying protobuf control messages between drivers, raylets
+and the GCS [unverified]). Design goals, per the tpu-first rewrite:
+
+- **No pickle in the envelope.** Every frame is msgpack (ints, strs,
+  bytes, lists, maps). Application payloads that *are* serialized Python
+  (task args, actor call args) travel as opaque ``bytes`` fields and are
+  only deserialized by application code after the connection has been
+  admitted to the cluster — admission requires the cluster token.
+- **Per-cluster secret.** The head generates a random token at startup
+  (``secrets.token_hex``), writes it to a 0600 file keyed by port, and
+  prints nothing secret. Joining processes present an HMAC-SHA256
+  challenge response; both sides authenticate (client proves knowledge,
+  server proves knowledge back), so a spoofed head cannot harvest
+  payloads either. This is what makes a non-loopback bind legal.
+- **Length-prefixed frames** (u32 BE) with a hard size cap; large objects
+  move as explicit chunked pulls above this layer, not giant frames.
+
+Errors cross the wire as ``{"type", "module", "message"}`` maps and are
+reconstructed from a module whitelist — never unpickled.
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import os
+import secrets
+import socket
+import struct
+import tempfile
+import threading
+from typing import Any, Optional, Tuple
+
+import msgpack
+
+MAX_FRAME = 1 << 30  # 1 GiB: chunked pulls should keep frames far below this
+_LEN = struct.Struct(">I")
+
+
+# ------------------------------------------------------------------- token --
+def token_dir() -> str:
+    d = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def token_path(port: int) -> str:
+    return os.path.join(token_dir(), f"cluster_token_{port}")
+
+
+def write_token(port: int, token: str) -> str:
+    path = token_path(port)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(token)
+    return path
+
+
+def generate_token() -> str:
+    return secrets.token_hex(16)
+
+
+def resolve_token(port: int, token: Optional[str] = None) -> str:
+    """Token lookup order: explicit arg > env > the head's token file
+    (same-machine discovery). Raises if none is found — there is no
+    insecure default."""
+    if token:
+        return token
+    env = os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+    if env:
+        return env
+    path = token_path(port)
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        raise ConnectionError(
+            f"no cluster token for port {port}: pass token=, set "
+            f"RAY_TPU_CLUSTER_TOKEN, or run on the head machine "
+            f"(token file {path})")
+
+
+# ------------------------------------------------------------------- codec --
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    # use_list=False: control tuples keep tuple identity round-trip.
+    return msgpack.unpackb(data, raw=False, use_list=False,
+                           strict_map_key=False)
+
+
+_EXC_MODULES = ("builtins", "ray_tpu.exceptions")
+
+
+def exc_to_wire(exc: BaseException) -> dict:
+    return {
+        "type": type(exc).__name__,
+        "module": type(exc).__module__,
+        "message": str(exc),
+    }
+
+
+def wire_to_exc(d: dict) -> BaseException:
+    mod, name, msg = d.get("module"), d.get("type", "RuntimeError"), \
+        d.get("message", "")
+    if mod in _EXC_MODULES:
+        import importlib
+
+        try:
+            cls = getattr(importlib.import_module(mod), name)
+            if isinstance(cls, type) and issubclass(cls, BaseException):
+                return cls(msg)
+        except Exception:  # noqa: BLE001 — fall through to generic
+            pass
+    return RuntimeError(f"{name}: {msg}")
+
+
+# ------------------------------------------------------------ connection ----
+class FramedConnection:
+    """One framed, authenticated socket. ``send``/``recv`` are individually
+    locked (one writer, one reader at a time); full-duplex use from
+    separate threads is supported."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._sendlock = threading.Lock()
+        self._recvlock = threading.Lock()
+        self._closed = False
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    # raw framing -----------------------------------------------------------
+    def _send_frame(self, payload: bytes):
+        if len(payload) > MAX_FRAME:
+            raise ValueError(f"frame too large: {len(payload)}")
+        with self._sendlock:
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("connection closed")
+            buf += chunk
+        return bytes(buf)
+
+    def _recv_frame(self) -> bytes:
+        with self._recvlock:
+            (length,) = _LEN.unpack(self._recv_exact(4))
+            if length > MAX_FRAME:
+                raise ValueError(f"frame too large: {length}")
+            return self._recv_exact(length)
+
+    # typed API -------------------------------------------------------------
+    def send(self, obj: Any):
+        self._send_frame(pack(obj))
+
+    def recv(self) -> Any:
+        return unpack(self._recv_frame())
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+
+def _hmac(token: str, tag: bytes, challenge: bytes) -> bytes:
+    return hmac.new(token.encode(), tag + challenge, hashlib.sha256).digest()
+
+
+def _server_handshake(conn: FramedConnection, token: str):
+    challenge = secrets.token_bytes(32)
+    conn._send_frame(challenge)
+    reply = conn._recv_frame()
+    if not hmac.compare_digest(reply, _hmac(token, b"client:", challenge)):
+        raise ConnectionError("cluster token mismatch (client)")
+    conn._send_frame(_hmac(token, b"server:", challenge))
+
+
+def _client_handshake(conn: FramedConnection, token: str):
+    challenge = conn._recv_frame()
+    conn._send_frame(_hmac(token, b"client:", challenge))
+    proof = conn._recv_frame()
+    if not hmac.compare_digest(proof, _hmac(token, b"server:", challenge)):
+        raise ConnectionError("cluster token mismatch (server)")
+
+
+def read_token_file(port: int) -> Optional[str]:
+    try:
+        with open(token_path(port)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+class TokenListener:
+    """Server side: accept() returns connections that passed the HMAC
+    challenge-response handshake. Failed handshakes are dropped. The
+    token may be (re)assigned after construction — the head binds first
+    to learn its port, then resolves the cluster token for that port."""
+
+    def __init__(self, host: str, port: int, token: Optional[str],
+                 backlog: int = 64):
+        self._token = token
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(backlog)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+
+    def set_token(self, token: str):
+        self._token = token
+
+    def accept(self) -> FramedConnection:
+        while True:
+            sock, _ = self._sock.accept()
+            conn = FramedConnection(sock)
+            try:
+                sock.settimeout(5.0)
+                _server_handshake(conn, self._token)
+                sock.settimeout(None)
+                return conn
+            except Exception:  # noqa: BLE001 — unauthenticated peer
+                conn.close()
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(host: str, port: int, token: str,
+            timeout: float = 10.0) -> FramedConnection:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    conn = FramedConnection(sock)
+    try:
+        _client_handshake(conn, token)
+    except Exception:
+        conn.close()
+        raise
+    sock.settimeout(None)
+    return conn

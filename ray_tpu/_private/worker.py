@@ -135,6 +135,7 @@ class Worker:
         # head service (GCS analogue) — KV becomes cluster-global, named
         # actors resolve across drivers, objects pull across drivers.
         self.head_client = None
+        self.remote_router = None
         if head_address:
             from ray_tpu._private.head_client import HeadClient
 
@@ -229,6 +230,10 @@ class Worker:
             self.memory_monitor = MemoryMonitor(
                 self.scheduler,
                 threshold_fraction=GlobalConfig.memory_monitor_threshold)
+        if self.head_client is not None:
+            from ray_tpu._private.remote_router import RemoteRouter
+
+            self.remote_router = RemoteRouter(self)
         self.submission_counter = _Counter()
         self.put_counter = _Counter()
         self.actor_counter = _Counter()
@@ -288,8 +293,35 @@ class Worker:
 
             self.store.put(object_id, SerializedObject.from_bytes(raw))
 
+    def _pull_wait(self, object_id: ObjectID, timeout: Optional[float]):
+        """Re-polling cross-driver pull: a foreign ref announced AFTER the
+        get starts must still resolve, so keep asking the head inside the
+        wait loop instead of pulling exactly once up front."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while not self.store.is_ready(object_id):
+            self._maybe_pull_from_head(object_id)
+            if self.store.is_ready(object_id):
+                return
+            if self.store.has_local_producer(object_id) or \
+                    self.scheduler.lineage_for(object_id.task_id()) \
+                    is not None:
+                return  # locally produced: the plain store wait covers it
+            remaining = 0.25
+            if deadline is not None:
+                remaining = min(0.25, deadline - _time.monotonic())
+                if remaining <= 0:
+                    return
+            self.store.wait([object_id], 1, remaining)
+
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
-        self._maybe_pull_from_head(ref.object_id)
+        router = self.remote_router
+        if router is not None and not self.store.is_ready(ref.object_id) \
+                and router.handles(ref.object_id):
+            router.ensure_local(ref.object_id, timeout=timeout)
+        elif self.head_client is not None:
+            self._pull_wait(ref.object_id, timeout)
         if self.store.is_lost(ref.object_id):
             # Lineage reconstruction (cluster mode): re-execute producers.
             cluster = getattr(self, "cluster", None)
@@ -315,16 +347,23 @@ class Worker:
         dep_refs = _collect_refs(spec.args, spec.kwargs)
         for ref in dep_refs:
             self.store.add_submitted_ref(ref.object_id)
-        for oid in spec.return_ids:
-            self.store.mark_local_producer(oid)
+        cluster = getattr(self, "cluster", None)
+        routed = (cluster is None and self.remote_router is not None
+                  and self.remote_router.maybe_route(spec))
+        if not routed:
+            # Remote results have no local producer — their bytes arrive
+            # by head-relayed pull, which a producer mark would suppress.
+            for oid in spec.return_ids:
+                self.store.mark_local_producer(oid)
         refs = [ObjectRef(oid) for oid in spec.return_ids]
         if dep_refs:
             def _release(_refs=dep_refs):
                 for r in _refs:
                     self.store.remove_submitted_ref(r.object_id)
             self.store.on_ready(spec.return_ids[0], _release)
-        cluster = getattr(self, "cluster", None)
-        if cluster is not None:
+        if routed:
+            pass  # the router owns dispatch + completion
+        elif cluster is not None:
             cluster.submit(spec)
         else:
             self.scheduler.submit(spec)
@@ -332,6 +371,14 @@ class Worker:
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
              timeout: Optional[float]):
+        router = self.remote_router
+        if router is not None:
+            # Completed-but-unpulled remote results count as ready only
+            # once local; fetch them in the background so wait() observes
+            # completion promptly.
+            for oid in object_ids:
+                if router.handles(oid) and not self.store.is_ready(oid):
+                    router._pool.submit(router.ensure_local, oid, 30.0)
         if self.head_client is not None:
             for oid in object_ids:
                 self._maybe_pull_from_head(oid)
@@ -395,6 +442,9 @@ class Worker:
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
             self.log_monitor = None
+        if self.remote_router is not None:
+            self.remote_router.shutdown()
+            self.remote_router = None
         if self.head_client is not None:
             self.head_client.close()
             self.head_client = None

@@ -83,19 +83,40 @@ def cmd_job(args):
 
 
 def cmd_start(args):
-    """Run the head control-plane service (reference: `ray start --head`).
-    Blocks; drivers attach with ray_tpu.init(address="host:port")."""
-    if not args.head:
-        raise SystemExit("only --head is supported (worker nodes attach "
-                         "via ray_tpu.init(address=...))")
-    from ray_tpu._private.head_service import HeadService
+    """Run a cluster role (reference: `ray start`). ``--head`` serves the
+    control plane (persisted for fault tolerance; drivers attach with
+    ray_tpu.init(address="host:port")); ``--address=host:port`` joins this
+    machine's worker pool to that head as a node daemon."""
+    if args.head:
+        import os
 
-    svc = HeadService(args.host, args.port)
-    print(f"ray_tpu head listening on {svc.host}:{svc.port}", flush=True)
-    try:
-        svc.serve_forever()
-    except KeyboardInterrupt:
-        svc.shutdown()
+        from ray_tpu._private.head_service import HeadService
+        from ray_tpu._private.transport import token_dir
+
+        state = args.state or os.path.join(
+            token_dir(), f"head_state_{args.port}.log")
+        svc = HeadService(args.host, args.port, state_path=state)
+        print(f"ray_tpu head listening on {svc.host}:{svc.port} "
+              f"(token file {svc.token_file})", flush=True)
+        try:
+            svc.serve_forever()
+        except KeyboardInterrupt:
+            svc.shutdown()
+        return
+    if args.address:
+        import json
+
+        from ray_tpu._private.node_daemon import NodeDaemon
+
+        daemon = NodeDaemon(
+            args.address, num_cpus=args.num_cpus,
+            resources=json.loads(args.resources))
+        print(f"ray_tpu node {daemon.worker.node_id.hex()[:16]} joined "
+              f"{args.address}", flush=True)
+        daemon.run_forever()
+        return
+    raise SystemExit("pass --head to serve the control plane or "
+                     "--address=host:port to join as a node")
 
 
 def cmd_logs(args):
@@ -147,6 +168,11 @@ def main(argv=None):
     p.add_argument("--head", action="store_true")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--state", default=None,
+                   help="head FT append-log path (--head only)")
+    p.add_argument("--address", default=None, help="join head as a node")
+    p.add_argument("--num-cpus", type=int, default=2)
+    p.add_argument("--resources", default="{}")
     p.set_defaults(fn=cmd_start)
     p = sub.add_parser("logs")
     p.add_argument("filename", nargs="?", default=None)

@@ -165,3 +165,76 @@ def test_named_actor_name_reusable_after_kill(head_proc):
         assert ray_tpu.get(a2.get.remote()) == 2
     finally:
         ray_tpu.shutdown()
+
+
+_SURVIVOR_CALLER = r"""
+import sys, time
+import ray_tpu
+
+address = sys.argv[1]
+ray_tpu.init(num_cpus=1, worker_mode="thread", address=address)
+g = ray_tpu.get_actor("survivor")
+print("CALL:" + ray_tpu.get(g.ping.remote(), timeout=30), flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def test_head_restart_recovers(tmp_path):
+    """GCS fault tolerance: kill -9 the head mid-session, restart it on
+    the same port with the same state log, and a surviving driver's KV
+    entries and named actor resolve again — including an actor call
+    relayed from a brand-new driver (SURVEY §5.3)."""
+    state = str(tmp_path / "head_state.log")
+    env = dict(os.environ)
+    env["RAY_TPU_HEAD_CLIENT_TIMEOUT_S"] = "3.0"
+
+    def spawn_head(port):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", str(port), "--state", state],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = proc.stdout.readline()
+        return proc, line.strip().rsplit(" ", 1)[-1]
+
+    ray_tpu.shutdown()
+    head1, address = spawn_head(0)
+    port = int(address.rsplit(":", 1)[1])
+    try:
+        worker = ray_tpu.init(num_cpus=2, worker_mode="thread",
+                              address=address, ignore_reinit_error=True)
+
+        @ray_tpu.remote
+        class Survivor:
+            def ping(self):
+                return "pong"
+
+        Survivor.options(name="survivor").remote()
+        worker.kv_put(b"ft/key", b"ft_value")
+
+        head1.kill()  # SIGKILL: no shutdown hooks, only the append-log
+        head1.wait(timeout=5)
+        head2, _ = spawn_head(port)
+        try:
+            # KV must be readable again (request channel re-dials).
+            deadline = time.time() + 20
+            value = None
+            while time.time() < deadline:
+                try:
+                    value = worker.kv_get(b"ft/key")
+                    if value is not None:
+                        break
+                except Exception:
+                    time.sleep(0.25)
+            assert value == b"ft_value"
+            # The surviving driver's named actor must resolve for a NEW
+            # driver and serve a relayed call (event channel re-dialed).
+            caller = subprocess.run(
+                [sys.executable, "-c", _SURVIVOR_CALLER, address],
+                capture_output=True, text=True, timeout=60, env=env)
+            assert "CALL:pong" in caller.stdout, (
+                caller.stdout, caller.stderr)
+        finally:
+            head2.kill()
+            head2.wait(timeout=5)
+    finally:
+        ray_tpu.shutdown()
