@@ -152,8 +152,11 @@ class HeadClient:
         while offset < size:
             length = min(_PULL_CHUNK, size - offset)
             chunk = self._request(("object_chunk", oid_bin, offset, length))
-            if chunk is None:
-                return None  # owner died mid-pull
+            if not chunk:
+                # None: owner died mid-pull. b'': owner re-announced with
+                # shorter bytes than the cached meta — either way this
+                # pull is void; the caller re-resolves from scratch.
+                return None
             parts.append(chunk)
             offset += len(chunk)
         return b"".join(parts)
@@ -197,15 +200,18 @@ class HeadClient:
             self._pool.submit(self._serve_event, rid, event)
 
     def _reconnect_event(self) -> bool:
+        """Re-dial until the head answers or this client shuts down — no
+        deadline: the heartbeat loop also retries forever, and a client the
+        head lists as alive MUST be able to serve relays, or its directory
+        entries poison every lookup (reconnect-and-resume contract)."""
         import time as _time
 
-        deadline = _time.monotonic() + 30.0
-        while not self._stop.is_set() and _time.monotonic() < deadline:
+        while not self._stop.is_set():
             try:
                 self._event = self._dial("event")
                 return True
             except Exception:  # noqa: BLE001 — head not back yet
-                _time.sleep(0.3)
+                _time.sleep(0.5)
         return False
 
     def _serve_event(self, rid: int, event: tuple):
@@ -214,8 +220,17 @@ class HeadClient:
         except Exception as exc:  # noqa: BLE001 — event boundary
             reply = ("rep", rid, "err", exc_to_wire(exc))
         try:
+            from ray_tpu._private.transport import pack
+
+            pack(reply)  # unpackable value? downgrade to a wire error
+        except Exception:  # noqa: BLE001
+            reply = ("rep", rid, "err", exc_to_wire(TypeError(
+                f"event reply for {event[0]!r} is not wire-encodable")))
+        try:
             self._event.send(reply)
-        except Exception:  # noqa: BLE001 — channel died; head will retry
+        except Exception:  # noqa: BLE001 — socket died: the head fails
+            # every pending relay on this channel (EventChannel.fail_all),
+            # so the caller is NOT left hanging; our event loop re-dials.
             pass
 
     def _serialized_bytes(self, oid_bin: bytes) -> bytes:

@@ -255,9 +255,11 @@ class HeadService:
 
     # ------------------------------------------------------------- serving
     def serve_forever(self):
+        # Handshakes run in the per-connection threads: a peer that stalls
+        # (or fails) its 5s handshake must not block new accepts.
         while not self._stop.is_set():
             try:
-                conn = self._listener.accept()
+                conn = self._listener.accept_raw()
             except OSError:
                 break
             threading.Thread(
@@ -265,6 +267,11 @@ class HeadService:
                 daemon=True).start()
 
     def _serve_conn(self, conn: FramedConnection):
+        try:
+            self._listener.server_handshake(conn)
+        except Exception:  # noqa: BLE001 — unauthenticated peer
+            conn.close()
+            return
         try:
             hello = conn.recv()  # ("hello", client_id, role)
             _, client_id, role = hello
@@ -368,25 +375,31 @@ class HeadService:
                     self._objects[msg[1]] = client_id
                 self._persist("object_announce", msg[1], client_id)
                 return ("ok", None)
+            # Object reads are bounded-latency relays: a wedged owner must
+            # not hang the pulling client's request thread forever (actor
+            # calls stay unbounded — long-running methods are legitimate).
             if kind == "object_pull":
                 _, oid_bin = msg
                 owner = self._object_owner(oid_bin)
                 if owner is None:
                     return ("ok", None)
-                return self._relay(owner, ("object_get", oid_bin))
+                return self._relay(owner, ("object_get", oid_bin),
+                                   timeout=60.0)
             if kind == "object_meta":
                 _, oid_bin = msg
                 owner = self._object_owner(oid_bin)
                 if owner is None:
                     return ("ok", None)
-                return self._relay(owner, ("object_meta", oid_bin))
+                return self._relay(owner, ("object_meta", oid_bin),
+                                   timeout=60.0)
             if kind == "object_chunk":
                 _, oid_bin, offset, length = msg
                 owner = self._object_owner(oid_bin)
                 if owner is None:
                     return ("ok", None)
                 return self._relay(
-                    owner, ("object_chunk", oid_bin, offset, length))
+                    owner, ("object_chunk", oid_bin, offset, length),
+                    timeout=60.0)
             if kind == "node_register":
                 _, node_id, resources = msg
                 with self._lock:
@@ -405,7 +418,8 @@ class HeadService:
                         for cl in self._clients.values() if cl.is_node])
             if kind == "task_push":
                 _, target_client, payload = msg
-                return self._relay(target_client, ("task_push", payload))
+                return self._relay(target_client, ("task_push", payload),
+                                   timeout=60.0)
             if kind == "task_done":
                 # Node -> head -> submitting driver. Record result object
                 # locations first so the driver's pull finds an owner even

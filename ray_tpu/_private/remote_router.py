@@ -56,6 +56,7 @@ class RemoteRouter:
         self._oid_owner: Dict[bytes, str] = {}    # done oids -> node client
         self._failed: Dict[TaskID, BaseException] = {}
         self._recovering: set = set()
+        self._prefetching: set = set()
         self._lock = threading.Lock()
         self._nodes_cache: tuple = (0.0, [])
         self._pool = ThreadPoolExecutor(
@@ -284,6 +285,25 @@ class RemoteRouter:
     def handles(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id.task_id() in self.lineage
+
+    def prefetch(self, object_id: ObjectID, timeout: float = 30.0):
+        """Background ensure_local with in-flight dedup: wait() polls may
+        call this repeatedly without saturating the router pool."""
+        with self._lock:
+            if object_id in self._prefetching:
+                return
+            self._prefetching.add(object_id)
+
+        def _run():
+            try:
+                self.ensure_local(object_id, timeout=timeout)
+            except Exception:  # noqa: BLE001 — best-effort prefetch
+                pass
+            finally:
+                with self._lock:
+                    self._prefetching.discard(object_id)
+
+        self._pool.submit(_run)
 
     def ensure_local(self, object_id: ObjectID,
                      timeout: Optional[float] = None) -> None:

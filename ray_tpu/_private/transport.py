@@ -223,14 +223,24 @@ class TokenListener:
     def set_token(self, token: str):
         self._token = token
 
+    def accept_raw(self) -> FramedConnection:
+        """Accept WITHOUT the handshake — run ``server_handshake`` in a
+        per-connection thread, so one slow or unauthenticated peer cannot
+        stall the accept loop for its 5s handshake timeout."""
+        sock, _ = self._sock.accept()
+        return FramedConnection(sock)
+
+    def server_handshake(self, conn: FramedConnection):
+        sock = conn._sock
+        sock.settimeout(5.0)
+        _server_handshake(conn, self._token)
+        sock.settimeout(None)
+
     def accept(self) -> FramedConnection:
         while True:
-            sock, _ = self._sock.accept()
-            conn = FramedConnection(sock)
+            conn = self.accept_raw()
             try:
-                sock.settimeout(5.0)
-                _server_handshake(conn, self._token)
-                sock.settimeout(None)
+                self.server_handshake(conn)
                 return conn
             except Exception:  # noqa: BLE001 — unauthenticated peer
                 conn.close()

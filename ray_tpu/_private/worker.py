@@ -378,7 +378,7 @@ class Worker:
             # completion promptly.
             for oid in object_ids:
                 if router.handles(oid) and not self.store.is_ready(oid):
-                    router._pool.submit(router.ensure_local, oid, 30.0)
+                    router.prefetch(oid)
         if self.head_client is not None:
             for oid in object_ids:
                 self._maybe_pull_from_head(oid)

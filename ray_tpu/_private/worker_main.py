@@ -106,7 +106,80 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
     ctx = SerializationContext()
     fn_cache: Dict[bytes, Any] = {}
     actor_instance: Optional[Any] = None
+    actor_state: Dict[str, Any] = {}  # concurrency plane for actor_new2
+    import threading as _threading_mod
+
+    rep_lock = _threading_mod.Lock()
     _stage_counter = [0]
+    _stage_lock = _threading_mod.Lock()  # concurrent actor calls stage too
+
+    def _reply(msg):
+        with rep_lock:
+            rep.write(msg)
+
+    def _stage_result(raw: bytes) -> int:
+        with _stage_lock:
+            _stage_counter[0] += 1
+            n = _stage_counter[0]
+        key = (0xA4D0_0000_0000_0000
+               | (os.getpid() & 0xFFFFFF) << 24
+               | (n & 0xFF_FFFF))
+        store.put(key, raw)
+        return key
+
+    def _finish_actor_call(call_id, result, return_keys, num_returns):
+        if return_keys:
+            _store_outputs(store, ctx, return_keys, result, num_returns)
+            _reply(("calldone", call_id, "ok", None))
+        else:
+            raw = ctx.serialize(result).to_bytes()
+            if len(raw) > max(max_msg // 4, 64 * 1024):
+                _reply(("calldone", call_id, "okshm", _stage_result(raw)))
+            else:
+                _reply(("calldone", call_id, "okv", raw))
+
+    def _fail_actor_call(call_id, name, exc):
+        try:
+            err = RayTaskError.from_exception(str(name), exc)
+            _reply(("calldone", call_id, "err", pickle.dumps(err)))
+        except Exception:  # noqa: BLE001 — unpicklable cause fallback
+            err = RayTaskError(str(name), traceback.format_exc(), cause=None)
+            _reply(("calldone", call_id, "err", pickle.dumps(err)))
+
+    def _run_actor_call_sync(call_id, method_name, payload, return_keys,
+                             num_returns, task_id_bin, name):
+        try:
+            method = getattr(actor_instance, method_name)
+            args, kwargs = _load_payload(store, ctx,
+                                         _fetch_blob(store, payload))
+            _set_task_ctx(task_id_bin, name)
+            try:
+                result = method(*args, **kwargs)
+            finally:
+                _set_task_ctx(None, None)
+            _finish_actor_call(call_id, result, return_keys, num_returns)
+        except BaseException as exc:  # noqa: BLE001 — call error boundary
+            _fail_actor_call(call_id, name, exc)
+
+    async def _run_actor_call_async(call_id, method_name, payload,
+                                    return_keys, num_returns, task_id_bin,
+                                    name):
+        import inspect as _inspect
+
+        try:
+            method = getattr(actor_instance, method_name)
+            args, kwargs = _load_payload(store, ctx,
+                                         _fetch_blob(store, payload))
+            _set_task_ctx(task_id_bin, name)
+            try:
+                result = method(*args, **kwargs)
+                if _inspect.iscoroutine(result):
+                    result = await result
+            finally:
+                _set_task_ctx(None, None)
+            _finish_actor_call(call_id, result, return_keys, num_returns)
+        except BaseException as exc:  # noqa: BLE001 — call error boundary
+            _fail_actor_call(call_id, name, exc)
 
     def _set_task_ctx(task_id_bin, name):
         worker_mod._task_context.current_task_id = (
@@ -127,10 +200,10 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
         kind = msg[0]
         try:
             if kind == "exit":
-                rep.write(("ok", None))
+                _reply(("ok", None))
                 return
             elif kind == "ping":
-                rep.write(("ok", os.getpid()))
+                _reply(("ok", os.getpid()))
             elif kind == "task":
                 (_, digest, fn_bytes, payload, return_keys, num_returns,
                  task_id_bin, name) = msg
@@ -146,14 +219,78 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 finally:
                     _set_task_ctx(None, None)
                 _store_outputs(store, ctx, return_keys, result, num_returns)
-                rep.write(("ok", None))
+                _reply(("ok", None))
             elif kind == "actor_new":
                 _, cls_bytes, payload = msg
                 cls = cloudpickle.loads(_fetch_blob(store, cls_bytes))
                 args, kwargs = _load_payload(store, ctx,
                                              _fetch_blob(store, payload))
                 actor_instance = cls(*args, **kwargs)
-                rep.write(("ok", None))
+                _reply(("ok", None))
+            elif kind == "actor_new2":
+                # Concurrent actor plane: async actors get a dedicated
+                # asyncio loop thread, threaded actors a pool; calls arrive
+                # as fire-and-forget "actor_submit" and complete out of
+                # order as ("calldone", call_id, ...) on the reply channel.
+                import threading as _threading
+
+                _, cls_bytes, payload, mode, max_concurrency = msg
+                cls = cloudpickle.loads(_fetch_blob(store, cls_bytes))
+                args, kwargs = _load_payload(store, ctx,
+                                             _fetch_blob(store, payload))
+                actor_instance = cls(*args, **kwargs)
+                actor_state["mode"] = mode
+                if mode == "async":
+                    import asyncio as _asyncio
+
+                    loop = _asyncio.new_event_loop()
+                    sem = _asyncio.Semaphore(max(int(max_concurrency), 1))
+
+                    def _loop_main():
+                        _asyncio.set_event_loop(loop)
+                        loop.run_forever()
+
+                    t = _threading.Thread(target=_loop_main, daemon=True,
+                                          name="actor-async-loop")
+                    t.start()
+                    actor_state["loop"] = loop
+                    actor_state["sem"] = sem
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    actor_state["pool"] = ThreadPoolExecutor(
+                        max_workers=max(int(max_concurrency), 1),
+                        thread_name_prefix="actor-call")
+                _reply(("ok", None))
+            elif kind == "actor_submit":
+                (_, call_id, method_name, payload, return_keys,
+                 num_returns, task_id_bin, name) = msg
+                if actor_instance is None:
+                    _fail_actor_call(call_id, name, RuntimeError(
+                        "actor_submit before actor_new2"))
+                elif actor_state.get("mode") == "async":
+                    import asyncio as _asyncio
+
+                    loop = actor_state["loop"]
+                    sem = actor_state["sem"]
+
+                    async def _gated(call_id=call_id,
+                                     method_name=method_name,
+                                     payload=payload,
+                                     return_keys=return_keys,
+                                     num_returns=num_returns,
+                                     task_id_bin=task_id_bin, name=name):
+                        async with sem:
+                            await _run_actor_call_async(
+                                call_id, method_name, payload, return_keys,
+                                num_returns, task_id_bin, name)
+
+                    _asyncio.run_coroutine_threadsafe(_gated(), loop)
+                else:
+                    actor_state["pool"].submit(
+                        _run_actor_call_sync, call_id, method_name,
+                        payload, return_keys, num_returns, task_id_bin,
+                        name)
             elif kind == "actor_call":
                 (_, method_name, payload, return_keys, num_returns,
                  task_id_bin, name) = msg
@@ -170,31 +307,26 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 if return_keys:
                     _store_outputs(store, ctx, return_keys, result,
                                    num_returns)
-                    rep.write(("ok", None))
+                    _reply(("ok", None))
                 else:
                     # Proxy apply (DAG exec loop): result rides the reply;
                     # big results stage through the store instead.
                     raw = ctx.serialize(result).to_bytes()
                     if len(raw) > max(max_msg // 4, 64 * 1024):
-                        _stage_counter[0] += 1
-                        key = (0xA4D0_0000_0000_0000
-                               | (os.getpid() & 0xFFFFFF) << 24
-                               | (_stage_counter[0] & 0xFF_FFFF))
-                        store.put(key, raw)
-                        rep.write(("okshm", key))
+                        _reply(("okshm", _stage_result(raw)))
                     else:
-                        rep.write(("ok", raw))
+                        _reply(("ok", raw))
             else:
                 raise ValueError(f"unknown request kind {kind!r}")
         except BaseException as exc:  # noqa: BLE001 — worker error boundary
             name = msg[1] if kind == "actor_call" else "task"
             try:
                 err = RayTaskError.from_exception(str(name), exc)
-                rep.write(("err", pickle.dumps(err)))
+                _reply(("err", pickle.dumps(err)))
             except Exception:  # noqa: BLE001 — unpicklable cause fallback
                 err = RayTaskError(str(name), traceback.format_exc(),
                                    cause=None)
-                rep.write(("err", pickle.dumps(err)))
+                _reply(("err", pickle.dumps(err)))
 
 
 def main(argv=None) -> int:

@@ -60,7 +60,8 @@ class _ActorRuntime:
 
     def __init__(self, actor_id: ActorID, cls: type, init_args, init_kwargs,
                  *, max_concurrency: int, max_restarts: int, name: str,
-                 actor_name: Optional[str]):
+                 actor_name: Optional[str],
+                 runtime_target: Optional[str] = None):
         self.actor_id = actor_id
         self.cls = cls
         self.init_args = init_args
@@ -83,15 +84,21 @@ class _ActorRuntime:
         if max_concurrency is None:
             max_concurrency = 1000 if self.is_async else 1
         self.max_concurrency = max(int(max_concurrency), 1)
-        # Process plane: plain sync actors live in a dedicated worker
-        # process (reference: every actor is a worker process), so an actor
-        # segfault/kill -9 never touches the driver. Async and
-        # multi-threaded actors keep the in-driver loop (their concurrency
-        # contract needs shared-memory threads, not a serialized channel).
+        # Process plane: EVERY actor flavor lives in a dedicated worker
+        # process (reference model: every actor is a worker process), so an
+        # actor segfault/kill -9 never touches the driver. Sync
+        # single-threaded actors use the simple request/reply channel;
+        # async and multi-threaded actors use the multiplexed submit/
+        # calldone protocol (out-of-order completions over the same
+        # channels). ``runtime="driver"`` opts back into the in-driver
+        # loop explicitly (e.g. actors that must share driver memory).
         worker = global_worker()
+        self.runtime_target = runtime_target
         self.use_process = (
             getattr(worker, "shm_store", None) is not None
-            and not self.is_async and self.max_concurrency == 1)
+            and runtime_target != "driver")
+        self.use_mux = self.use_process and (
+            self.is_async or self.max_concurrency > 1)
         self._proc = None
         self._restart_pending = False
         self.pid: Optional[int] = None
@@ -103,7 +110,7 @@ class _ActorRuntime:
         self._init_error: Optional[BaseException] = None
         mailbox = self._mailbox
         if self.use_process:
-            target = self._run_proc
+            target = self._run_proc_mux if self.use_mux else self._run_proc
         else:
             target = self._run_async if self.is_async else self._run_sync
         self._thread = threading.Thread(
@@ -218,7 +225,12 @@ class _ActorRuntime:
             staged += st
             payload, st = maybe_stage(worker.shm_store, payload, limit)
             staged += st
-            proc.request(("actor_new", cls_bytes, payload))
+            if self.use_mux:
+                mode = "async" if self.is_async else "threaded"
+                proc.request(("actor_new2", cls_bytes, payload, mode,
+                              self.max_concurrency))
+            else:
+                proc.request(("actor_new", cls_bytes, payload))
         except BaseException:
             proc.shutdown(timeout=0.1)
             raise
@@ -275,6 +287,240 @@ class _ActorRuntime:
                     self.actor_id, self.death_cause or "actor is dead"))
                 continue
             self._execute_call_proc(worker, call)
+
+    # ------------------------------------ concurrent process-backed actor
+    def _run_proc_mux(self, mailbox):
+        """Mailbox loop for async/threaded actors in a worker process:
+        calls are fire-and-forget 'actor_submit' writes; a pump thread
+        matches out-of-order ('calldone', call_id, …) completions, so up
+        to max_concurrency calls overlap inside the worker while this
+        loop keeps dispatching (reference: every actor is a worker
+        process, including asyncio and threaded actors — SURVEY §3.3)."""
+        worker = global_worker()
+        try:
+            self._proc = self._spawn_proc()
+            self.pid = self._proc.pid
+            self._init_error = None
+        except BaseException as e:  # noqa: BLE001 — init error boundary
+            self._init_error = e
+            self.dead = True
+            self.death_cause = f"__init__ failed: {e!r}"
+            self._instance_ready.set()
+            self._drain_with_error(mailbox)
+            return
+        self.instance = _ProcessActorProxy(self)
+        self._mux_pending: Dict[int, dict] = {}
+        self._mux_lock = threading.Lock()
+        self._mux_call_counter = 0
+        self._start_pump(worker)
+        self._instance_ready.set()
+        while True:
+            call = mailbox.get()
+            if call is _TERMINATE:
+                if self._proc is not None:
+                    self._proc.shutdown(timeout=0.5)
+                return
+            if isinstance(call, _ClosureCall):
+                try:
+                    call.fn(self.instance)
+                except Exception:  # noqa: BLE001 — exec loop boundary
+                    pass
+                continue
+            if (self._restart_pending or not self._proc.alive()) \
+                    and not self.dead:
+                self._mux_respawn(worker)
+            if self.dead:
+                self._fail_call(worker, call, ActorDiedError(
+                    self.actor_id, self.death_cause or "actor is dead"))
+                continue
+            self._mux_dispatch(worker, call)
+
+    def _mux_respawn(self, worker):
+        """Replace a dead/killed worker process with a fresh one (fresh
+        actor state), consuming restart budget unless terminate() already
+        counted it."""
+        if self._restart_pending:
+            consume = False
+        elif self.restarts_used < self.max_restarts:
+            consume = True
+        else:
+            self.dead = True
+            self.death_cause = (self.death_cause
+                                or "actor worker process died")
+            return
+        self._restart_pending = False
+        if consume:
+            self.restarts_used += 1
+        # Drain in-flight calls against the dead process FIRST: the old
+        # pump may exit via its proc-identity check without failing them,
+        # and nothing else ever would (hang). Waiters are notified too —
+        # their liveness probe watches the captured (dead) proc, not the
+        # healthy replacement.
+        with self._mux_lock:
+            pending, self._mux_pending = dict(self._mux_pending), {}
+        err = ActorDiedError(
+            self.actor_id, self.death_cause or "actor worker process died")
+        for entry in pending.values():
+            if "waiter" in entry:
+                entry["status"] = "died"
+                entry["waiter"].set()
+                continue
+            self._fail_call(worker, entry["call"], err)
+            for key in entry["staged"]:
+                try:
+                    worker.shm_store.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+        try:
+            self._proc.shutdown(timeout=0.1)
+            self._proc = self._spawn_proc()
+            self.pid = self._proc.pid
+            self._start_pump(worker)
+        except BaseException as e:  # noqa: BLE001
+            self.dead = True
+            self.death_cause = f"restart failed: {e!r}"
+
+    def _mux_dispatch(self, worker, call: _MethodCall):
+        from ray_tpu._private.worker_pool import (
+            maybe_stage,
+            oid_key,
+            pack_args,
+        )
+
+        if call.cancelled:
+            self._fail_call(worker, call, TaskCancelledError())
+            return
+        shm = worker.shm_store
+        task_id = call.return_ids[0].task_id()
+        worker.task_events.record(task_id, "RUNNING", name=call.name)
+        staged: list = []
+        ret_keys = [oid_key(oid) for oid in call.return_ids]
+        call_id = None
+        try:
+            args, kwargs = _resolve_actor_args(worker, call)
+            payload, staged = pack_args(
+                shm, worker.serialization_context, args, kwargs)
+            payload, st = maybe_stage(
+                shm, payload, max(self._proc.max_msg // 4, 64 * 1024))
+            staged += st
+            for key in ret_keys:
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._mux_lock:
+                self._mux_call_counter += 1
+                call_id = self._mux_call_counter
+                self._mux_pending[call_id] = {
+                    "call": call, "staged": staged, "ret_keys": ret_keys,
+                }
+            self._proc._req.write(
+                ("actor_submit", call_id, call.method_name, payload,
+                 ret_keys, len(call.return_ids), task_id.binary(),
+                 call.name), timeout=60.0)
+        except BaseException as exc:  # noqa: BLE001 — dispatch boundary
+            with self._mux_lock:
+                if call_id is not None:
+                    self._mux_pending.pop(call_id, None)
+            for key in staged:
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+            if isinstance(exc, RayTaskError):
+                self._fail_call(worker, call, exc)
+            else:
+                self._fail_call(
+                    worker, call, RayTaskError.from_exception(call.name, exc))
+            worker.task_events.record(task_id, "FAILED", name=call.name)
+
+    def _start_pump(self, worker):
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, args=(worker, self._proc), daemon=True,
+            name=f"actor-pump-{self.class_name}")
+        self._pump_thread.start()
+
+    def _pump_loop(self, worker, proc):
+        """Read out-of-order completions off the reply channel; on worker
+        death fail every in-flight call with ActorDiedError (the
+        interrupted calls are NOT retried — reference restart
+        semantics)."""
+        import pickle as _pickle
+
+        from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu.exceptions import ChannelError, ChannelTimeoutError
+
+        shm = worker.shm_store
+        while True:
+            try:
+                msg = proc._rep.read(timeout=0.2)
+            except ChannelTimeoutError:
+                if not proc.alive() or proc is not self._proc:
+                    break
+                continue
+            except (ChannelError, Exception):  # noqa: BLE001 — torn down
+                break
+            if not msg or msg[0] != "calldone":
+                continue
+            _, call_id, status, value = msg
+            with self._mux_lock:
+                entry = self._mux_pending.pop(call_id, None)
+            if entry is None:
+                continue
+            if "waiter" in entry:  # proxy apply: hand over and notify
+                entry["status"], entry["value"] = status, value
+                entry["waiter"].set()
+                continue
+            call = entry["call"]
+            try:
+                if status == "ok":
+                    for oid, key in zip(call.return_ids,
+                                        entry["ret_keys"]):
+                        raw = bytes(shm.get(key))
+                        worker.store.put(
+                            oid, SerializedObject.from_bytes(raw))
+                        shm.delete(key)
+                    worker.task_events.record(
+                        call.return_ids[0].task_id(), "FINISHED",
+                        name=call.name)
+                elif status == "err":
+                    self._fail_call(worker, call, _pickle.loads(value))
+                    worker.task_events.record(
+                        call.return_ids[0].task_id(), "FAILED",
+                        name=call.name)
+                else:  # okv/okshm belong to proxy waiters; shouldn't hit
+                    self._fail_call(worker, call, RayActorError(
+                        self.actor_id, f"unexpected status {status!r}"))
+            except Exception as exc:  # noqa: BLE001 — completion boundary
+                self._fail_call(
+                    worker, call,
+                    RayTaskError.from_exception(call.name, exc))
+            finally:
+                for key in entry["staged"]:
+                    try:
+                        shm.delete(key)
+                    except Exception:  # noqa: BLE001
+                        pass
+        # Worker died (or was replaced): fail everything still in flight
+        # against THIS process.
+        if proc is not self._proc:
+            return
+        with self._mux_lock:
+            pending, self._mux_pending = dict(self._mux_pending), {}
+        err = ActorDiedError(
+            self.actor_id,
+            self.death_cause or "actor worker process died")
+        for entry in pending.values():
+            if "waiter" in entry:
+                entry["status"] = "died"
+                entry["waiter"].set()
+                continue
+            self._fail_call(worker, entry["call"], err)
+            for key in entry["staged"]:
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _execute_call_proc(self, worker, call: _MethodCall):
         from ray_tpu._private.serialization import SerializedObject
@@ -341,6 +587,8 @@ class _ActorRuntime:
         if self.dead or self._proc is None or not self._proc.alive():
             raise ActorDiedError(self.actor_id,
                                  self.death_cause or "actor is dead")
+        if self.use_mux:
+            return self._proxy_apply_mux(worker, method_name, args, kwargs)
         shm = worker.shm_store
         payload, staged = pack_args(
             shm, worker.serialization_context, args, kwargs)
@@ -361,6 +609,59 @@ class _ActorRuntime:
             self.dead = True
             self.death_cause = f"actor worker process died: {e}"
             raise ActorDiedError(self.actor_id, self.death_cause) from e
+        finally:
+            for key in staged:
+                try:
+                    shm.delete(key)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _proxy_apply_mux(self, worker, method_name: str, args, kwargs):
+        """Proxy apply over the multiplexed channel: register a waiter the
+        pump thread resolves (the pump owns the reply channel, so the
+        plain request() path would steal its frames)."""
+        import pickle as _pickle
+
+        from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu._private.worker_pool import maybe_stage, pack_args
+
+        shm = worker.shm_store
+        payload, staged = pack_args(
+            shm, worker.serialization_context, args, kwargs)
+        payload, st = maybe_stage(
+            shm, payload, max(self._proc.max_msg // 4, 64 * 1024))
+        staged += st
+        entry = {"waiter": threading.Event(), "status": None, "value": None}
+        proc = self._proc  # liveness must track the proc we dispatched to
+        try:
+            with self._mux_lock:
+                self._mux_call_counter += 1
+                call_id = self._mux_call_counter
+                self._mux_pending[call_id] = entry
+            proc._req.write(
+                ("actor_submit", call_id, method_name, payload, [], 1,
+                 b"", method_name), timeout=60.0)
+            while not entry["waiter"].wait(timeout=0.5):
+                if not proc.alive():
+                    with self._mux_lock:
+                        self._mux_pending.pop(call_id, None)
+                    if entry["status"] is None:
+                        entry["status"] = "died"
+                    break
+            status, value = entry["status"], entry["value"]
+            if status == "okv":
+                return worker.serialization_context.deserialize(
+                    SerializedObject.from_bytes(value))
+            if status == "okshm":
+                raw = bytes(shm.get(value))
+                shm.delete(value)
+                return worker.serialization_context.deserialize(
+                    SerializedObject.from_bytes(raw))
+            if status == "err":
+                raise _pickle.loads(value).as_instanceof_cause() from None
+            self.dead = True
+            self.death_cause = "actor worker process died"
+            raise ActorDiedError(self.actor_id, self.death_cause)
         finally:
             for key in staged:
                 try:
@@ -716,6 +1017,7 @@ class ActorClass:
                 max_restarts=max_restarts,
                 name=self._cls.__name__,
                 actor_name=actor_name,
+                runtime_target=opts.get("runtime"),
             )
         except BaseException:
             if actor_name and worker.head_client is not None:

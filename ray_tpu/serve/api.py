@@ -181,6 +181,23 @@ def ingress(fastapi_app=None):
     return wrap
 
 
+# --------------------------------------------------- decorator local state
+# Per-process registry for decorator state that must not travel with the
+# pickled wrapper (locks, queues, caches). Keyed by a uuid token baked into
+# the wrapper closure; each process (driver, replica worker) materializes
+# its own instance on first call.
+_decorator_states: Dict[str, Any] = {}
+_decorator_states_lock = threading.Lock()
+
+
+def _decorator_state(token: str, factory):
+    with _decorator_states_lock:
+        st = _decorator_states.get(token)
+        if st is None:
+            st = _decorator_states[token] = factory()
+        return st
+
+
 # ----------------------------------------------------------------- batching
 def batch(_fn=None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01):
@@ -193,8 +210,16 @@ def batch(_fn=None, *, max_batch_size: int = 8,
     """
 
     def wrap(fn):
-        lock = threading.Lock()
-        pending: List = []  # (arg, event, slot)
+        # Decorator state (lock + queue) is created lazily PER PROCESS via
+        # a token-keyed registry: the wrapper must survive cloudpickle into
+        # a replica's worker process, and a captured _thread.lock cannot.
+        import uuid as _uuid
+
+        token = _uuid.uuid4().hex
+
+        def _state():
+            return _decorator_state(
+                token, lambda: {"lock": threading.Lock(), "pending": []})
 
         def flush(batch_items):
             args = [it[0] for it in batch_items]
@@ -222,6 +247,8 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                 self_obj, arg = None, call_args[0]
             event = threading.Event()
             slot: Dict[str, Any] = {}
+            st = _state()
+            lock, pending = st["lock"], st["pending"]
             with lock:
                 pending.append((arg, event, slot, self_obj))
                 is_leader = len(pending) == 1
@@ -262,11 +289,16 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
     """
 
     def wrap(fn):
-        cache: "OrderedDict[str, Any]" = OrderedDict()
-        lock = threading.Lock()
+        import uuid as _uuid
+
+        token = _uuid.uuid4().hex
 
         @functools.wraps(fn)
         def wrapper(self_or_id, model_id=None):
+            st = _decorator_state(
+                token,
+                lambda: {"lock": threading.Lock(), "cache": OrderedDict()})
+            lock, cache = st["lock"], st["cache"]
             if model_id is None:
                 self_obj, mid = None, self_or_id
             else:

@@ -101,6 +101,37 @@ class DeploymentResponseGenerator:
         self._inner._release()
 
 
+class _DetachedRouter:
+    """Controller stand-in for handles that crossed a process boundary
+    (e.g. a handle passed into a replica's constructor): routes over a
+    snapshot of the deployment's replica actor handles — which pickle —
+    instead of the driver-local controller. Autoscaling changes after the
+    snapshot are not observed (reference parity: handles cache their
+    replica set and refresh from the controller; the refresh channel here
+    is re-sending the handle)."""
+
+    def __init__(self, replicas):
+        from ray_tpu.serve.router import ReplicaSet
+
+        self._rs = ReplicaSet()
+        self._rs.update(list(replicas))
+
+    def _replica_set(self, name):
+        return self._rs
+
+    def _record_request(self, name):
+        pass
+
+
+def _rebuild_deployment_handle(name, method, stream, replicas):
+    handle = DeploymentHandle.__new__(DeploymentHandle)
+    handle._name = name
+    handle._controller = _DetachedRouter(replicas)
+    handle._method = method
+    handle._stream = stream
+    return handle
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
                  method_name: str = "__call__", stream: bool = False):
@@ -108,6 +139,12 @@ class DeploymentHandle:
         self._controller = controller
         self._method = method_name
         self._stream = stream
+
+    def __reduce__(self):
+        rs = self._controller._replica_set(self._name)
+        return (_rebuild_deployment_handle,
+                (self._name, self._method, self._stream,
+                 list(rs._replicas)))
 
     def options(self, method_name: Optional[str] = None, *,
                 stream: Optional[bool] = None) -> "DeploymentHandle":

@@ -412,7 +412,10 @@ def test_actor_dag_channels_preserve_device_residency(ray_start_regular):
     stages without serialization or host transfer."""
     import jax.numpy as jnp
 
-    @ray_tpu.remote(max_concurrency=2)  # thread actor: shares the driver
+    # runtime="driver" is the explicit opt-in for actors that must share
+    # driver memory — the zero-copy device-array channel needs it now that
+    # threaded actors default to worker processes.
+    @ray_tpu.remote(max_concurrency=2, runtime="driver")
     class Stage:
         def apply(self, x):
             # Identity-preserving: return the SAME buffer object.

@@ -112,19 +112,19 @@ def test_replica_failure_recovery():
 
 
 def test_batching_coalesces():
-    batch_sizes = []
-
+    # Replicas run in worker processes: evidence must ride the results,
+    # not a driver-closure list (each item reports its batch's size).
     @serve.deployment
     class Model:
         @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
         def __call__(self, xs):
-            batch_sizes.append(len(xs))
-            return [x * 2 for x in xs]
+            return [(x * 2, len(xs)) for x in xs]
 
     handle = serve.run(Model.bind())
     responses = [handle.remote(i) for i in range(16)]
     results = sorted(r.result() for r in responses)
-    assert results == [i * 2 for i in range(16)]
+    assert [v for v, _ in results] == [i * 2 for i in range(16)]
+    batch_sizes = [b for _, b in results]
     assert max(batch_sizes) > 1  # coalescing actually happened
 
 
