@@ -220,14 +220,17 @@ class HeadClient:
         except Exception as exc:  # noqa: BLE001 — event boundary
             reply = ("rep", rid, "err", exc_to_wire(exc))
         try:
-            from ray_tpu._private.transport import pack
-
-            pack(reply)  # unpackable value? downgrade to a wire error
-        except Exception:  # noqa: BLE001
-            reply = ("rep", rid, "err", exc_to_wire(TypeError(
-                f"event reply for {event[0]!r} is not wire-encodable")))
-        try:
             self._event.send(reply)
+        except (TypeError, ValueError):
+            # msgpack failed BEFORE any bytes hit the socket (send packs
+            # first): downgrade the unencodable value to a wire error so
+            # the head's relay caller is not left waiting.
+            try:
+                self._event.send(("rep", rid, "err", exc_to_wire(TypeError(
+                    f"event reply for {event[0]!r} is not "
+                    f"wire-encodable"))))
+            except Exception:  # noqa: BLE001
+                pass
         except Exception:  # noqa: BLE001 — socket died: the head fails
             # every pending relay on this channel (EventChannel.fail_all),
             # so the caller is NOT left hanging; our event loop re-dials.

@@ -32,7 +32,41 @@ from ray_tpu._private.serialization import SerializationContext
 from ray_tpu._private.task_events import TaskEventBuffer
 from ray_tpu.exceptions import RayTaskError, RayTpuError
 
-_task_context = threading.local()
+class _TaskContext:
+    """Per-execution task context. Backed by contextvars rather than
+    threading.local so ASYNC actor calls — many coroutines interleaving
+    on one event-loop thread — each see their own task id across await
+    points (asyncio tasks run in copied contexts). For plain threads the
+    semantics match threading.local: each thread's sets are isolated."""
+
+    __slots__ = ("_tid", "_name")
+
+    def __init__(self):
+        import contextvars
+
+        object.__setattr__(self, "_tid", contextvars.ContextVar(
+            "ray_tpu_task_id", default=None))
+        object.__setattr__(self, "_name", contextvars.ContextVar(
+            "ray_tpu_task_name", default=None))
+
+    @property
+    def current_task_id(self):
+        return self._tid.get()
+
+    @current_task_id.setter
+    def current_task_id(self, value):
+        self._tid.set(value)
+
+    @property
+    def task_name(self):
+        return self._name.get()
+
+    @task_name.setter
+    def task_name(self, value):
+        self._name.set(value)
+
+
+_task_context = _TaskContext()
 
 
 class ObjectRef:

@@ -659,9 +659,13 @@ class _ActorRuntime:
                     SerializedObject.from_bytes(raw))
             if status == "err":
                 raise _pickle.loads(value).as_instanceof_cause() from None
-            self.dead = True
-            self.death_cause = "actor worker process died"
-            raise ActorDiedError(self.actor_id, self.death_cause)
+            # The worker died mid-call. Do NOT mark the actor dead here:
+            # _mux_respawn may already have restarted it within budget —
+            # only this interrupted call fails (reference restart
+            # semantics: interrupted calls are not retried).
+            raise ActorDiedError(
+                self.actor_id,
+                self.death_cause or "actor worker process died mid-call")
         finally:
             for key in staged:
                 try:

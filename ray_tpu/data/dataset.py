@@ -283,8 +283,17 @@ class Dataset:
         return list(s) if s else None
 
     def iter_blocks(self) -> Iterator[Block]:
-        for ref in self._materialize_refs():
+        """Pull-based consumption: blocks stream out of the pipeline as
+        they are produced (iter_batches over this never materializes the
+        whole dataset — SURVEY §2.5 streaming executor)."""
+        from ray_tpu.data.executor import stream_plan
+        from ray_tpu.data.stats import DatasetStats
+
+        ray_tpu.init(ignore_reinit_error=True)
+        stats = DatasetStats()
+        for ref, _ in stream_plan(self._operators, stats=stats):
             yield ray_tpu.get(ref)
+        self._stats = stats
 
     def iter_rows(self) -> Iterator[Dict]:
         for b in self.iter_blocks():

@@ -1,18 +1,34 @@
 """Streaming executor (reference role:
 python/ray/data/_internal/execution/streaming_executor.py).
 
-Pull-based pipeline over block ObjectRefs: map-class operators dispatch
-ray_tpu tasks over blocks with a bounded in-flight window (backpressure —
-the ResourceManager budget analogue), streaming completed blocks to the
-next operator as they finish rather than materializing each stage.
-All-to-all operators (sort/shuffle/groupby/repartition) are barriers that
-consume every input block.
+A driver scheduling loop pumps a pipeline of operators connected by
+bounded queues of **RefBundles** — ``(block_ref, num_rows)`` pairs. Blocks
+themselves never round-trip through the driver between map-class stages:
+
+- map/read tasks ``put`` their output blocks task-side and return only
+  the (ref, rows) metadata, so the driver handles bytes only at an
+  explicit sink (``iter_*``/``take``) — the ResourceManager/streaming-gen
+  analogue of the reference;
+- every streaming operator dispatches as soon as it has input and budget
+  (``select_operator_to_run`` analogue): operator 2 starts on operator
+  1's first completed block, not after its last;
+- backpressure is two-sided: per-operator ``max_in_flight`` tasks plus a
+  bounded inter-operator queue, and the sink generator only pumps the
+  loop when the consumer pulls (``iter_batches`` streams end to end);
+- all-to-all operators (sort/shuffle/groupby/repartition) remain
+  barriers by nature: they run when their upstream completes, as
+  parallel task fan-outs whose reduce outputs are again task-side puts.
+
+Block order is part of the Dataset contract: completions are harvested
+in submission order per operator (head-of-line), which preserves order
+while still overlapping stages.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -25,57 +41,86 @@ from ray_tpu.data.block import (
 )
 from ray_tpu.data.stats import DatasetStats, OpStats
 
+# The unit flowing between operators: (block ObjectRef, row count).
+RefBundle = Tuple[Any, int]
+
 
 class Operator:
-    """Physical operator: transforms a stream of block refs."""
+    """Physical operator base. Streaming operators implement the
+    dispatch/harvest pair; barrier operators implement execute()."""
 
     name = "op"
+    streaming = False
 
     def execute(self, in_refs: List[Any], stats: DatasetStats) -> List[Any]:
         raise NotImplementedError
 
 
+def _put_blocks_remote(blocks: List[Block]) -> List[RefBundle]:
+    """Task-side block publication: store each output block from inside
+    the task and ship only (ref, rows) metadata back."""
+    out = []
+    for b in blocks:
+        out.append((ray_tpu.put(b), block_num_rows(b)))
+    return out
+
+
 class MapOperator(Operator):
-    """Streaming task-pool map: bounded in-flight tasks over blocks."""
+    """Streaming task-pool map over block refs."""
+
+    streaming = True
 
     def __init__(self, name: str, block_fn: Callable[[Block], List[Block]],
                  max_in_flight: int = 8):
         self.name = name
         self._block_fn = block_fn
         self._max_in_flight = max_in_flight
-
-    def execute(self, in_refs, stats):
-        t0 = time.perf_counter()
-
-        fn = self._block_fn
+        fn = block_fn
 
         @ray_tpu.remote
         def _apply(block):
-            return fn(block)
+            return _put_blocks_remote(fn(block))
 
-        out_refs: List[Any] = []
-        pending: List[Any] = []
-        for ref in in_refs:
-            pending.append(_apply.remote(ref))
-            if len(pending) >= self._max_in_flight:
-                # Backpressure on the oldest task: block order is part of
-                # the Dataset contract, so collect in submission order.
-                ray_tpu.wait([pending[0]], num_returns=1)
-                out_refs.append(pending.pop(0))
-        out_refs.extend(pending)
-        # Each task returns a list of blocks; flatten lazily via a second
-        # hop would cost a task per block — resolve the lists here instead.
-        flat: List[Any] = []
-        for ref in out_refs:
-            blocks = ray_tpu.get(ref)
-            for b in blocks:
-                flat.append(ray_tpu.put(b))
-        rows = sum(
-            block_num_rows(ray_tpu.get(r)) for r in flat)
-        stats.ops.append(OpStats(
-            name=self.name, wall_s=time.perf_counter() - t0,
-            output_blocks=len(flat), output_rows=rows))
-        return flat
+        self._task = _apply
+
+    # streaming interface ---------------------------------------------------
+    def num_inputs(self) -> Optional[int]:
+        return None  # consumes upstream bundles
+
+    def dispatch(self, item: RefBundle):
+        ref, _ = item
+        return self._task.remote(ref)
+
+    def harvest(self, out_ref) -> List[RefBundle]:
+        return list(ray_tpu.get(out_ref))  # metadata only: [(ref, rows)]
+
+
+class InputOperator(Operator):
+    """Source: produces blocks from read tasks (executed remotely)."""
+
+    streaming = True
+
+    def __init__(self, name: str,
+                 read_tasks: List[Callable[[], List[Block]]],
+                 max_in_flight: int = 8):
+        self.name = name
+        self._read_tasks = read_tasks
+        self._max_in_flight = max_in_flight
+
+        @ray_tpu.remote
+        def _read(task):
+            return _put_blocks_remote(task())
+
+        self._task = _read
+
+    def num_inputs(self) -> Optional[int]:
+        return len(self._read_tasks)
+
+    def dispatch(self, item):
+        return self._task.remote(item)  # item is a read-task callable
+
+    def harvest(self, out_ref) -> List[RefBundle]:
+        return list(ray_tpu.get(out_ref))
 
 
 def _compose_block_fns(f, g):
@@ -128,8 +173,8 @@ class ShuffleOperator(Operator):
     """Two-stage push shuffle (reference role: push-based shuffle /
     ShuffleTaskScheduler): map tasks partition each input block into P
     parts, then one reduce task per partition combines its parts from
-    every map. Both stages run as parallel ray_tpu tasks; the driver
-    never concatenates the whole dataset (the old barrier behavior)."""
+    every map. Both stages run as parallel ray_tpu tasks; reduce outputs
+    are task-side puts, so the driver never touches block bytes."""
 
     MAX_PARTITIONS = 32
 
@@ -160,7 +205,7 @@ class ShuffleOperator(Operator):
 
         @ray_tpu.remote
         def _reduce(p, *parts):
-            return red(list(parts), p)
+            return _put_blocks_remote(red(list(parts), p))
 
         map_refs = []
         for i, ref in enumerate(in_refs):
@@ -175,9 +220,9 @@ class ShuffleOperator(Operator):
             _reduce.remote(p, *[m[p] for m in map_refs]) for p in range(P)
         ]
         for rref in reduce_refs:  # partition order IS output order
-            for b in ray_tpu.get(rref):
-                rows += block_num_rows(b)
-                out_refs.append(ray_tpu.put(b))
+            for ref, n in ray_tpu.get(rref):
+                rows += n
+                out_refs.append(ref)
         stats.ops.append(OpStats(
             name=self.name, wall_s=time.perf_counter() - t0,
             output_blocks=len(out_refs), output_rows=rows))
@@ -243,7 +288,9 @@ class RangeShuffleOperator(ShuffleOperator):
 
 
 class AllToAllOperator(Operator):
-    """Barrier operator: consumes all blocks, emits a new block list."""
+    """Barrier operator: consumes all blocks, emits a new block list.
+    Runs driver-side (used for whole-dataset reshapes like repartition
+    and zip, where one function sees every block)."""
 
     def __init__(self, name: str,
                  fn: Callable[[List[Block]], List[Block]]):
@@ -262,75 +309,230 @@ class AllToAllOperator(Operator):
         return refs
 
 
-class InputOperator(Operator):
-    """Source: produces blocks from read tasks (executed remotely)."""
-
-    def __init__(self, name: str,
-                 read_tasks: List[Callable[[], List[Block]]],
-                 max_in_flight: int = 8):
-        self.name = name
-        self._read_tasks = read_tasks
-        self._max_in_flight = max_in_flight
-
-    def execute(self, in_refs, stats):
-        t0 = time.perf_counter()
-
-        @ray_tpu.remote
-        def _read(task):
-            return task()
-
-        out: List[Any] = []
-        pending: List[Any] = []
-        for task in self._read_tasks:
-            pending.append(_read.remote(task))
-            if len(pending) >= self._max_in_flight:
-                ray_tpu.wait([pending[0]], num_returns=1)
-                out.append(pending.pop(0))
-        out.extend(pending)
-        flat: List[Any] = []
-        rows = 0
-        for ref in out:
-            for b in ray_tpu.get(ref):
-                rows += block_num_rows(b)
-                flat.append(ray_tpu.put(b))
-        stats.ops.append(OpStats(
-            name=self.name, wall_s=time.perf_counter() - t0,
-            output_blocks=len(flat), output_rows=rows))
-        return flat
-
-
 class LimitOperator(Operator):
+    """Streaming limit with early termination: passes bundles through by
+    metadata until the limit is hit, slices the boundary block in a task,
+    then tells the scheduler to stop pumping upstream."""
+
+    streaming = True
+
     def __init__(self, limit: int):
         self.name = f"Limit[{limit}]"
         self._limit = limit
 
-    def execute(self, in_refs, stats):
-        t0 = time.perf_counter()
-        out: List[Any] = []
-        remaining = self._limit
-        for ref in in_refs:
-            if remaining <= 0:
+    def num_inputs(self) -> Optional[int]:
+        return None
+
+
+def _limit_slice_task():
+    @ray_tpu.remote
+    def _slice(block, n):
+        return [(ray_tpu.put({k: v[:n] for k, v in block.items()}), n)]
+
+    return _slice
+
+
+# --------------------------------------------------------------------------
+# The streaming scheduling loop
+# --------------------------------------------------------------------------
+class _OpState:
+    __slots__ = ("op", "inputs", "inflight", "dispatched", "harvested",
+                 "done", "started_at", "rows", "blocks", "source_items",
+                 "finished_at", "truncated")
+
+    def __init__(self, op):
+        self.op = op
+        self.inputs: deque = deque()
+        self.inflight: deque = deque()  # FIFO of out_refs (order contract)
+        self.done = False
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.rows = 0
+        self.blocks = 0
+        self.truncated = False  # limit hit: stop pumping upstream
+        n = op.num_inputs() if op.streaming else None
+        if op.streaming and n is not None:
+            self.source_items = deque(op._read_tasks)
+        else:
+            self.source_items = None
+
+
+def stream_plan(operators: List[Operator], *, fuse: bool = True,
+                stats: Optional[DatasetStats] = None
+                ) -> Iterator[RefBundle]:
+    """Generator over the sink's RefBundles, produced as the pipeline
+    streams. Pumps the scheduling loop only when the consumer pulls
+    (pull-based sink); abandoning the generator stops further dispatch."""
+    ops = fuse_plan(operators) if fuse else list(operators)
+    st: List[_OpState] = [_OpState(op) for op in ops]
+    t_start = time.perf_counter()
+    out: deque = deque()  # sink bundles ready to yield
+    _stats = stats if stats is not None else DatasetStats()
+
+    def _upstream_done(i: int) -> bool:
+        return i == 0 or st[i - 1].done
+
+    def _record(i: int):
+        s = st[i]
+        if s.finished_at is None:
+            s.finished_at = time.perf_counter()
+            _stats.ops.append(OpStats(
+                name=s.op.name,
+                wall_s=s.finished_at - (s.started_at or s.finished_at),
+                output_blocks=s.blocks, output_rows=s.rows))
+
+    def _push_down(i: int, bundles: List[RefBundle]):
+        s = st[i]
+        s.blocks += len(bundles)
+        s.rows += sum(n for _, n in bundles)
+        if i + 1 < len(st):
+            st[i + 1].inputs.extend(bundles)
+        else:
+            out.extend(bundles)
+
+    def _pump_once() -> bool:
+        progress = False
+        for i, s in enumerate(st):
+            if s.done:
+                continue
+            op = s.op
+            if not op.streaming:
+                # Barrier: runs once when its upstream is exhausted.
+                if _upstream_done(i) and not s.inflight:
+                    s.started_at = time.perf_counter()
+                    refs = [r for r, _ in s.inputs]
+                    s.inputs.clear()
+                    out_refs = op.execute(refs, _stats)
+                    metas = [(r, None) for r in out_refs]
+                    # Barrier stats were recorded by execute(); resolve
+                    # row counts lazily only if a downstream limit needs
+                    # them (None rows means "unknown").
+                    s.blocks += len(out_refs)
+                    s.done = True
+                    s.finished_at = time.perf_counter()
+                    if i + 1 < len(st):
+                        st[i + 1].inputs.extend(metas)
+                    else:
+                        out.extend(metas)
+                    progress = True
+                continue
+            if isinstance(op, LimitOperator):
+                progress |= _pump_limit(i, s)
+                continue
+            # Harvest head-of-line completions (order preservation).
+            while s.inflight:
+                head = s.inflight[0]
+                ready, _ = ray_tpu.wait([head], num_returns=1, timeout=0)
+                if not ready:
+                    break
+                s.inflight.popleft()
+                _push_down(i, s.op.harvest(head))
+                progress = True
+            # Dispatch while input + budget + downstream headroom exist.
+            # The queue cap only applies when downstream consumes
+            # incrementally (streaming op or the pull-based sink): a
+            # barrier needs EVERY upstream bundle before it runs, so
+            # capping its input queue would deadlock the pipeline.
+            budget = op._max_in_flight
+            down_cap = 2 * budget + 8
+            if i + 1 < len(st):
+                downstream_len = (len(st[i + 1].inputs)
+                                  if st[i + 1].op.streaming else -1)
+            else:
+                downstream_len = len(out)
+            if downstream_len < 0:
+                downstream_len, down_cap = 0, float("inf")
+            while len(s.inflight) < budget and downstream_len < down_cap:
+                if s.source_items is not None:
+                    if not s.source_items:
+                        break
+                    item = s.source_items.popleft()
+                elif s.inputs:
+                    item = s.inputs.popleft()
+                else:
+                    break
+                if s.started_at is None:
+                    s.started_at = time.perf_counter()
+                s.inflight.append(op.dispatch(item))
+                downstream_len += 1
+                progress = True
+            # Completion: no pending input anywhere and upstream is done.
+            if not s.inflight and not s.inputs and (
+                    s.source_items is not None and not s.source_items
+                    or s.source_items is None and _upstream_done(i)):
+                s.done = True
+                _record(i)
+                progress = True
+        return progress
+
+    def _pump_limit(i: int, s) -> bool:
+        op: LimitOperator = s.op
+        progress = False
+        # Boundary slice in flight: harvest it.
+        while s.inflight:
+            head = s.inflight[0]
+            ready, _ = ray_tpu.wait([head], num_returns=1, timeout=0)
+            if not ready:
                 break
-            b = ray_tpu.get(ref)
-            n = block_num_rows(b)
+            s.inflight.popleft()
+            _push_down(i, list(ray_tpu.get(head)))
+            progress = True
+        remaining = op._limit - s.rows
+        while s.inputs and remaining > 0 and not s.inflight:
+            ref, n = s.inputs.popleft()
+            if s.started_at is None:
+                s.started_at = time.perf_counter()
+            if n is None:  # barrier upstream: resolve the count now
+                n = block_num_rows(ray_tpu.get(ref))
             if n <= remaining:
-                out.append(ref)
+                _push_down(i, [(ref, n)])
                 remaining -= n
             else:
-                out.append(ray_tpu.put(
-                    {k: v[:remaining] for k, v in b.items()}))
+                s.inflight.append(
+                    _limit_slice_task().remote(ref, remaining))
                 remaining = 0
-        stats.ops.append(OpStats(
-            name=self.name, wall_s=time.perf_counter() - t0,
-            output_blocks=len(out), output_rows=self._limit - remaining))
-        return out
+            progress = True
+        if remaining <= 0 and not s.inflight and not s.truncated:
+            # Early termination: upstream work is moot.
+            s.truncated = True
+            for j in range(i):
+                st[j].done = True
+                st[j].inputs.clear()
+                if st[j].source_items is not None:
+                    st[j].source_items.clear()
+                st[j].inflight.clear()
+                _record(j)
+            s.done = True
+            _record(i)
+            progress = True
+        elif not s.inflight and not s.inputs and _upstream_done(i):
+            s.done = True
+            _record(i)
+            progress = True
+        return progress
+
+    try:
+        while True:
+            while out:
+                ref, n = out.popleft()
+                if n is None:
+                    n = block_num_rows(ray_tpu.get(ref))
+                yield (ref, n)
+            if all(s.done for s in st) and not out:
+                break
+            if not _pump_once() and not out:
+                # Nothing completed and nothing dispatchable: block
+                # briefly on ANY in-flight task instead of spinning.
+                inflight = [r for s in st for r in s.inflight]
+                if inflight:
+                    ray_tpu.wait(inflight, num_returns=1, timeout=0.1)
+    finally:
+        _stats.total_wall_s = time.perf_counter() - t_start
 
 
-def execute_plan(operators: List[Operator]) -> (List[Any], DatasetStats):
+def execute_plan(operators: List[Operator], *, fuse: bool = True
+                 ) -> Tuple[List[Any], DatasetStats]:
     stats = DatasetStats()
-    t0 = time.perf_counter()
-    refs: List[Any] = []
-    for op in fuse_plan(operators):
-        refs = op.execute(refs, stats)
-    stats.total_wall_s = time.perf_counter() - t0
+    refs = [ref for ref, _ in stream_plan(operators, fuse=fuse,
+                                          stats=stats)]
     return refs, stats
