@@ -219,18 +219,19 @@ class HeadClient:
             reply = ("rep", rid, "ok", self._handle_event(event))
         except Exception as exc:  # noqa: BLE001 — event boundary
             reply = ("rep", rid, "err", exc_to_wire(exc))
+        from ray_tpu._private.transport import pack
+
         try:
-            self._event.send(reply)
-        except (TypeError, ValueError):
-            # msgpack failed BEFORE any bytes hit the socket (send packs
-            # first): downgrade the unencodable value to a wire error so
-            # the head's relay caller is not left waiting.
-            try:
-                self._event.send(("rep", rid, "err", exc_to_wire(TypeError(
-                    f"event reply for {event[0]!r} is not "
-                    f"wire-encodable"))))
-            except Exception:  # noqa: BLE001
-                pass
+            # Pack exactly once, separately from the socket write, so ANY
+            # encode failure (TypeError, OverflowError on ints >= 2**64,
+            # RecursionError...) downgrades to a wire error instead of
+            # being mistaken for a dead socket and silently dropped.
+            data = pack(reply)
+        except Exception:  # noqa: BLE001 — unencodable value
+            data = pack(("rep", rid, "err", exc_to_wire(TypeError(
+                f"event reply for {event[0]!r} is not wire-encodable"))))
+        try:
+            self._event._send_frame(data)
         except Exception:  # noqa: BLE001 — socket died: the head fails
             # every pending relay on this channel (EventChannel.fail_all),
             # so the caller is NOT left hanging; our event loop re-dials.

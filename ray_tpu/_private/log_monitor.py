@@ -62,18 +62,22 @@ class LogMonitor:
                 offset = self._offsets.get(fname, 0)
                 if size <= offset:
                     continue
-                with open(path, "r", errors="replace") as f:
+                # Binary mode: offsets stay in TRUE file bytes. Decoding
+                # with errors='replace' first would turn each invalid
+                # byte (1 on disk) into U+FFFD (3 re-encoded), inflating
+                # the offset and silently skipping later log content.
+                with open(path, "rb") as f:
                     f.seek(offset)
                     chunk = f.read()
                 # Only complete lines; partial tails re-read next pass.
-                end = chunk.rfind("\n")
+                end = chunk.rfind(b"\n")
                 if end < 0:
                     continue
-                self._offsets[fname] = offset + len(
-                    chunk[:end + 1].encode("utf-8", errors="replace"))
-                for line in chunk[:end].splitlines():
-                    if line:
-                        self._emit(fname, line)
+                self._offsets[fname] = offset + end + 1
+                for raw in chunk[:end].split(b"\n"):
+                    if raw:
+                        self._emit(fname,
+                                   raw.decode("utf-8", errors="replace"))
             except OSError:
                 continue
 

@@ -133,6 +133,7 @@ class LocalScheduler:
         # cycle with _submit_native (scheduler lock -> store.contains).
         self._shm_resident: Dict[Any, int] = {}  # ObjectID -> shm key
         self._shm_key_pins: Dict[int, int] = {}  # key -> in-flight count
+        self._deferred_deletes: set = set()  # pinned keys awaiting delete
         self._pin_lock = threading.Lock()  # leaf lock: nothing nests in it
         # Tasks whose workers the memory monitor killed: their crash is
         # reported as OutOfMemoryError, not a generic worker crash.
@@ -420,8 +421,52 @@ class LocalScheduler:
                 n = self._shm_key_pins.get(key, 0) - 1
                 if n <= 0:
                     self._shm_key_pins.pop(key, None)
+                    if key in self._deferred_deletes:
+                        # Deferred by _clear_ret_keys mid-read. Delete
+                        # UNDER the pin lock: resolvers pin before their
+                        # contains() check, so an unpinned key here
+                        # cannot acquire a new reader before the delete
+                        # (same invariant as _maybe_flush_residents).
+                        self._deferred_deletes.discard(key)
+                        try:
+                            self._shm_store.delete(key)
+                        except Exception:  # noqa: BLE001 — reclaimed
+                            pass
                 else:
                     self._shm_key_pins[key] = n
+
+    def _clear_ret_keys(self, keys):
+        """Delete stale ret keys WITHOUT breaking the pin invariant: a
+        key a consumer is reading right now (lineage re-execution racing
+        an in-flight arg read) is deferred — deleted at unpin — rather
+        than yanked mid-read. Check-and-delete happens under the pin
+        lock, mirroring _maybe_flush_residents, so a reader cannot pin
+        between the check and the delete. Retries never NEED these slots:
+        ret keys are salted by attempt number."""
+        for key in keys:
+            with self._pin_lock:
+                if key in self._shm_key_pins:
+                    self._deferred_deletes.add(key)
+                    continue
+                self._deferred_deletes.discard(key)
+                try:
+                    self._shm_store.delete(key)
+                except Exception:  # noqa: BLE001 — not present
+                    pass
+
+    @staticmethod
+    def _ret_key(oid, attempt: int) -> int:
+        """Shm slot for one return of one attempt. Salting by attempt
+        means a retry writes FRESH slots: a consumer still pinned to a
+        prior attempt's output can finish its read (the stale slot is
+        deferred-deleted at unpin) while the retry proceeds — no
+        'exists' collision, no yank mid-read."""
+        from ray_tpu._private.worker_pool import oid_key
+
+        base = oid_key(oid)
+        if attempt:
+            base ^= (attempt * 0x9E37_79B9_7F4A_7C15)
+        return base & 0x0FFF_FFFF_FFFF_FFFF
 
     def _maybe_flush_residents(self):
         """Pressure valve: residency is a read-through cache (the python
@@ -473,7 +518,6 @@ class LocalScheduler:
         from ray_tpu._private.serialization import SerializedObject
         from ray_tpu._private.worker import global_worker
         from ray_tpu._private.worker_pool import (
-            oid_key,
             pack_args,
             pack_function,
         )
@@ -483,7 +527,8 @@ class LocalScheduler:
         ctx = global_worker().serialization_context
         w = self._worker_pool.lease()
         staged: list = []
-        ret_keys = [oid_key(oid) for oid in spec.return_ids]
+        ret_keys = [self._ret_key(oid, spec.attempt)
+                    for oid in spec.return_ids]
         try:
             digest, fn_bytes = pack_function(spec.function)
             payload, staged = pack_args(self._shm_store, ctx, args, kwargs)
@@ -494,12 +539,17 @@ class LocalScheduler:
             payload, st = maybe_stage(self._shm_store, payload, limit)
             staged += st
             # A prior attempt may have died AFTER storing outputs but
-            # BEFORE replying; clear any stale ret keys so the worker's
-            # put can't fail with "exists" on the retry (and drop stale
-            # residency from a lineage re-execution of the same task).
+            # BEFORE replying; clear this attempt's and the previous
+            # attempt's stale slots (pin-respecting, deferred if a reader
+            # is mid-flight) so the arena doesn't leak across retries,
+            # and drop stale residency from lineage re-execution.
             for oid in spec.return_ids:
                 self._shm_resident.pop(oid, None)
-            self._delete_shm_keys(ret_keys)
+            stale = list(ret_keys)
+            if spec.attempt > 0:
+                stale += [self._ret_key(oid, spec.attempt - 1)
+                          for oid in spec.return_ids]
+            self._clear_ret_keys(stale)
             with self._lock:
                 self._proc_running[spec.task_id] = w
             try:
@@ -520,8 +570,9 @@ class LocalScheduler:
             self._maybe_flush_residents()
         except BaseException:
             # Failure path: a crashed worker may have left some ret keys
-            # behind — reclaim the shm slots.
-            self._delete_shm_keys(ret_keys)
+            # behind — reclaim the shm slots (pins respected: a consumer
+            # mid-read defers the delete to its unpin).
+            self._clear_ret_keys(ret_keys)
             raise
         finally:
             self._delete_shm_keys(staged)

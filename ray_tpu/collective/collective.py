@@ -43,6 +43,7 @@ _rank_of: Dict[tuple, int] = {}  # (group, thread-ident) -> rank
 _seq: Dict[tuple, int] = {}      # (group, rank) -> collective round
 _p2p_send: Dict[tuple, int] = {}  # (group, src, dst) -> send round
 _p2p_recv: Dict[tuple, int] = {}  # (group, src, dst) -> recv round
+_epoch_of: Dict[str, str] = {}    # group -> epoch this process joined
 _lock = threading.Lock()
 
 
@@ -56,12 +57,32 @@ def _meta_key(group: str) -> bytes:
     return f"col|{group}|meta".encode()
 
 
-def _round_key(group: str, seq: int, rank: int) -> bytes:
-    return f"col|{group}|r{seq}|{rank}".encode()
+def _parse_meta(raw: bytes) -> tuple:
+    """Meta value is 'world_size|epoch'. The epoch changes every time the
+    group is (re)created, so a process-backed actor that survived a
+    destroy + re-create cannot desync rounds: its stale counters reset on
+    re-join, and its stale round keys live under the old epoch prefix."""
+    text = raw.decode()
+    if "|" in text:
+        ws, epoch = text.split("|", 1)
+        return int(ws), epoch
+    return int(text), ""
+
+
+def _group_epoch(group: str) -> str:
+    with _lock:
+        return _epoch_of.get(group, "")
+
+
+def _round_key(group: str, seq: int, rank: int,
+               epoch: Optional[str] = None) -> bytes:
+    e = _group_epoch(group) if epoch is None else epoch
+    return f"col|{group}|{e}|r{seq}|{rank}".encode()
 
 
 def _p2p_key(group: str, src: int, dst: int, seq: int) -> bytes:
-    return f"col|{group}|p2p|{src}|{dst}|{seq}".encode()
+    return f"col|{group}|{_group_epoch(group)}|p2p|{src}|{dst}|{seq}" \
+        .encode()
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -69,18 +90,32 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
     """Join the calling worker to a named group (reference signature
     parity; backend is advisory — 'xla' here, vs 'nccl'/'gloo' there)."""
+    import uuid
+
     w = _worker()
     existing = w.kv_get(_meta_key(group_name))
     if existing is None:
-        w.kv_put(_meta_key(group_name), str(world_size).encode(),
+        w.kv_put(_meta_key(group_name),
+                 f"{world_size}|{uuid.uuid4().hex[:8]}".encode(),
                  overwrite=False)
         existing = w.kv_get(_meta_key(group_name))
-    if int(existing) != world_size:
+    ws, epoch = _parse_meta(existing)
+    if ws != world_size:
         raise ValueError(
             f"group {group_name!r} exists with world_size "
-            f"{int(existing)} != {world_size}")
+            f"{ws} != {world_size}")
     with _lock:
         _rank_of[(group_name, threading.get_ident())] = rank
+        if _epoch_of.get(group_name) != epoch:
+            # The group was re-created since this process last joined:
+            # stale round counters from the previous epoch must reset or
+            # this rank posts round N while fresh ranks poll round 0.
+            for k in [k for k in _seq if k[0] == group_name]:
+                _seq.pop(k, None)
+            for d in (_p2p_send, _p2p_recv):
+                for k in [k for k in d if k[0] == group_name]:
+                    d.pop(k, None)
+            _epoch_of[group_name] = epoch
         _seq.setdefault((group_name, rank), 0)
 
 
@@ -121,7 +156,7 @@ def _world_size(group_name: str) -> int:
     raw = _worker().kv_get(_meta_key(group_name))
     if raw is None:
         raise RuntimeError(f"no collective group {group_name!r}")
-    return int(raw)
+    return _parse_meta(raw)[0]
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -254,3 +289,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
         for d in (_p2p_send, _p2p_recv):
             for k in [k for k in d if k[0] == group_name]:
                 d.pop(k, None)
+        # A re-created group mints a fresh epoch; forgetting ours makes
+        # the next init_collective_group adopt it and reset counters even
+        # in OTHER processes (their cached epoch no longer matches).
+        _epoch_of.pop(group_name, None)

@@ -47,13 +47,22 @@ RefBundle = Tuple[Any, int]
 
 class Operator:
     """Physical operator base. Streaming operators implement the
-    dispatch/harvest pair; barrier operators implement execute()."""
+    dispatch/harvest pair; barrier operators implement execute() (refs
+    in, refs out) or execute_bundles() when they know row counts."""
 
     name = "op"
     streaming = False
 
     def execute(self, in_refs: List[Any], stats: DatasetStats) -> List[Any]:
         raise NotImplementedError
+
+    def execute_bundles(self, in_bundles: List["RefBundle"],
+                        stats: DatasetStats) -> List["RefBundle"]:
+        """Barrier entry point for the streaming loop. Default adapts
+        legacy execute(); rows of unknown-count outputs stay None (the
+        sink passes None through — nothing fetches blocks to count)."""
+        refs = self.execute([r for r, _ in in_bundles], stats)
+        return [(r, None) for r in refs]
 
 
 def _put_blocks_remote(blocks: List[Block]) -> List[RefBundle]:
@@ -190,7 +199,12 @@ class ShuffleOperator(Operator):
             max(len(in_refs), 1), self.MAX_PARTITIONS)
 
     def execute(self, in_refs, stats):
+        return [r for r, _ in self.execute_bundles(
+            [(r, None) for r in in_refs], stats)]
+
+    def execute_bundles(self, in_bundles, stats):
         t0 = time.perf_counter()
+        in_refs = [r for r, _ in in_bundles]
         if not in_refs:
             stats.ops.append(OpStats(self.name, 0.0, 0, 0))
             return []
@@ -214,7 +228,7 @@ class ShuffleOperator(Operator):
                     _map.options(num_returns=P).remote(ref, i))
             else:
                 map_refs.append([_map.remote(ref, i)])
-        out_refs: List[Any] = []
+        out: List[RefBundle] = []
         rows = 0
         reduce_refs = [
             _reduce.remote(p, *[m[p] for m in map_refs]) for p in range(P)
@@ -222,11 +236,11 @@ class ShuffleOperator(Operator):
         for rref in reduce_refs:  # partition order IS output order
             for ref, n in ray_tpu.get(rref):
                 rows += n
-                out_refs.append(ref)
+                out.append((ref, n))
         stats.ops.append(OpStats(
             name=self.name, wall_s=time.perf_counter() - t0,
-            output_blocks=len(out_refs), output_rows=rows))
-        return out_refs
+            output_blocks=len(out), output_rows=rows))
+        return out
 
 
 class RangeShuffleOperator(ShuffleOperator):
@@ -243,7 +257,8 @@ class RangeShuffleOperator(ShuffleOperator):
         super().__init__(name, None, reduce_fn,
                          num_partitions=num_partitions)
 
-    def execute(self, in_refs, stats):
+    def execute_bundles(self, in_bundles, stats):
+        in_refs = [r for r, _ in in_bundles]
         if not in_refs:
             stats.ops.append(OpStats(self.name, 0.0, 0, 0))
             return []
@@ -284,7 +299,7 @@ class RangeShuffleOperator(ShuffleOperator):
                     for p in range(P)]
 
         self._partition_fn = partition
-        return super().execute(in_refs, stats)
+        return super().execute_bundles(in_bundles, stats)
 
 
 class AllToAllOperator(Operator):
@@ -324,21 +339,17 @@ class LimitOperator(Operator):
         return None
 
 
-def _limit_slice_task():
-    @ray_tpu.remote
-    def _slice(block, n):
-        return [(ray_tpu.put({k: v[:n] for k, v in block.items()}), n)]
-
-    return _slice
+@ray_tpu.remote
+def _limit_slice(block, n):
+    return [(ray_tpu.put({k: v[:n] for k, v in block.items()}), n)]
 
 
 # --------------------------------------------------------------------------
 # The streaming scheduling loop
 # --------------------------------------------------------------------------
 class _OpState:
-    __slots__ = ("op", "inputs", "inflight", "dispatched", "harvested",
-                 "done", "started_at", "rows", "blocks", "source_items",
-                 "finished_at", "truncated")
+    __slots__ = ("op", "inputs", "inflight", "done", "started_at", "rows",
+                 "blocks", "source_items", "finished_at", "truncated")
 
     def __init__(self, op):
         self.op = op
@@ -400,14 +411,13 @@ def stream_plan(operators: List[Operator], *, fuse: bool = True,
                 # Barrier: runs once when its upstream is exhausted.
                 if _upstream_done(i) and not s.inflight:
                     s.started_at = time.perf_counter()
-                    refs = [r for r, _ in s.inputs]
+                    in_bundles = list(s.inputs)
                     s.inputs.clear()
-                    out_refs = op.execute(refs, _stats)
-                    metas = [(r, None) for r in out_refs]
-                    # Barrier stats were recorded by execute(); resolve
-                    # row counts lazily only if a downstream limit needs
-                    # them (None rows means "unknown").
-                    s.blocks += len(out_refs)
+                    metas = op.execute_bundles(in_bundles, _stats)
+                    # Barrier stats were recorded by execute_bundles();
+                    # unknown row counts stay None (nothing fetches
+                    # blocks just to count them).
+                    s.blocks += len(metas)
                     s.done = True
                     s.finished_at = time.perf_counter()
                     if i + 1 < len(st):
@@ -488,8 +498,7 @@ def stream_plan(operators: List[Operator], *, fuse: bool = True,
                 _push_down(i, [(ref, n)])
                 remaining -= n
             else:
-                s.inflight.append(
-                    _limit_slice_task().remote(ref, remaining))
+                s.inflight.append(_limit_slice.remote(ref, remaining))
                 remaining = 0
             progress = True
         if remaining <= 0 and not s.inflight and not s.truncated:
@@ -514,10 +523,10 @@ def stream_plan(operators: List[Operator], *, fuse: bool = True,
     try:
         while True:
             while out:
-                ref, n = out.popleft()
-                if n is None:
-                    n = block_num_rows(ray_tpu.get(ref))
-                yield (ref, n)
+                # Unknown counts (barrier outputs) pass through as None —
+                # consumers that only want refs must not force a driver
+                # fetch of every block just to count rows.
+                yield out.popleft()
             if all(s.done for s in st) and not out:
                 break
             if not _pump_once() and not out:

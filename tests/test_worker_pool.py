@@ -342,3 +342,41 @@ def test_ref_args_pass_through_shm_without_driver_copy(proc_runtime):
     sched._release_shm_resident(ref.object_id)
     assert ref.object_id not in sched._shm_resident
     assert not proc_runtime.shm_store.contains(key)
+
+
+def test_collective_group_recreate_resets_stale_rounds(proc_runtime):
+    """Epoch keying: a process actor that joined group G keeps living
+    after destroy_collective_group(G); re-creating G with the same name
+    and REUSING that actor must not desync rounds (the stale rank used
+    to post round N while fresh ranks polled round 0 — every collective
+    timed out)."""
+    import numpy as np
+    from ray_tpu import collective as col
+
+    @ray_tpu.remote
+    class W:
+        def collective_join(self, world_size, rank, backend, group):
+            col.init_collective_group(world_size, rank, backend, group)
+            return rank
+
+        def reduce(self, group, v):
+            return float(col.allreduce(
+                np.full((8,), float(v)), group_name=group).sum())
+
+    a, b = W.remote(), W.remote()
+    col.create_collective_group([a, b], world_size=2, ranks=[0, 1],
+                                group_name="gepoch")
+    # Advance a's round counter past 0.
+    outs = ray_tpu.get([a.reduce.remote("gepoch", 1),
+                        b.reduce.remote("gepoch", 2)], timeout=60)
+    assert outs == [24.0, 24.0]
+    col.destroy_collective_group("gepoch")
+
+    # Same name, same surviving actor `a` (stale counter), fresh actor c.
+    c = W.remote()
+    col.create_collective_group([a, c], world_size=2, ranks=[0, 1],
+                                group_name="gepoch")
+    outs = ray_tpu.get([a.reduce.remote("gepoch", 5),
+                        c.reduce.remote("gepoch", 7)], timeout=60)
+    assert outs == [96.0, 96.0]
+    col.destroy_collective_group("gepoch")
