@@ -161,6 +161,8 @@ def bench_actor_pipeline(n_iters=200):
             "suite": "actor_pipeline_4",
             "executions_per_sec": 1.0 / med,
             "p50_e2e_latency_us": med * 1e6,
+            "transport": ("shm" if getattr(compiled, "_shm_mode", False)
+                          else "driver"),
         }
     finally:
         compiled.teardown()

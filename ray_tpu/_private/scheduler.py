@@ -435,24 +435,39 @@ class LocalScheduler:
                 else:
                     self._shm_key_pins[key] = n
 
-    def _clear_ret_keys(self, keys):
+    def _clear_ret_keys(self, keys, wait_for_reuse_s: float = 0.0):
         """Delete stale ret keys WITHOUT breaking the pin invariant: a
-        key a consumer is reading right now (lineage re-execution racing
-        an in-flight arg read) is deferred — deleted at unpin — rather
-        than yanked mid-read. Check-and-delete happens under the pin
-        lock, mirroring _maybe_flush_residents, so a reader cannot pin
-        between the check and the delete. Retries never NEED these slots:
-        ret keys are salted by attempt number."""
-        for key in keys:
-            with self._pin_lock:
-                if key in self._shm_key_pins:
-                    self._deferred_deletes.add(key)
-                    continue
-                self._deferred_deletes.discard(key)
-                try:
-                    self._shm_store.delete(key)
-                except Exception:  # noqa: BLE001 — not present
-                    pass
+        key a consumer is reading right now is deferred — deleted at
+        unpin — rather than yanked mid-read. Check-and-delete happens
+        under the pin lock, mirroring _maybe_flush_residents, so a reader
+        cannot pin between the check and the delete.
+
+        Scheduler retries never reuse these slots (ret keys are salted by
+        attempt), but LINEAGE re-execution re-submits with the SAME
+        attempt — its worker must be able to re-put the key. Pass
+        ``wait_for_reuse_s`` > 0 on that path: briefly wait for readers
+        to unpin so the slot actually frees; if one outlasts the wait,
+        the worker's put fails 'exists' (retriable) instead of the
+        reader seeing torn bytes."""
+        deadline = time.monotonic() + wait_for_reuse_s
+        remaining = list(keys)
+        while True:
+            still = []
+            for key in remaining:
+                with self._pin_lock:
+                    if key in self._shm_key_pins:
+                        self._deferred_deletes.add(key)
+                        still.append(key)
+                        continue
+                    self._deferred_deletes.discard(key)
+                    try:
+                        self._shm_store.delete(key)
+                    except Exception:  # noqa: BLE001 — not present
+                        pass
+            remaining = still
+            if not remaining or time.monotonic() >= deadline:
+                return
+            time.sleep(0.01)
 
     @staticmethod
     def _ret_key(oid, attempt: int) -> int:
@@ -545,11 +560,15 @@ class LocalScheduler:
             # and drop stale residency from lineage re-execution.
             for oid in spec.return_ids:
                 self._shm_resident.pop(oid, None)
-            stale = list(ret_keys)
+            # Current-attempt keys must actually free (lineage re-execution
+            # reuses the attempt number, so its worker re-puts the SAME
+            # key): wait briefly for readers. Prior-attempt slots are
+            # never rewritten — pure deferral is fine.
+            self._clear_ret_keys(ret_keys, wait_for_reuse_s=1.0)
             if spec.attempt > 0:
-                stale += [self._ret_key(oid, spec.attempt - 1)
-                          for oid in spec.return_ids]
-            self._clear_ret_keys(stale)
+                self._clear_ret_keys(
+                    [self._ret_key(oid, spec.attempt - 1)
+                     for oid in spec.return_ids])
             with self._lock:
                 self._proc_running[spec.task_id] = w
             try:

@@ -68,6 +68,48 @@ def _store_outputs(store, ctx, return_keys: List[int], result: Any,
         store.put(key, ctx.serialize(value).to_bytes())
 
 
+def _run_dag_stages(store, desc: dict, actor_instance) -> None:
+    """Worker-resident compiled-DAG exec loop over shm channels.
+
+    Never raises: any failure is logged to stderr (the driver's log
+    plane) and terminates the loop — the caller must reply exactly once
+    on the request channel, so a stray exception here must not reach the
+    main loop's error boundary (double reply = protocol desync).
+    """
+    from ray_tpu.channels.channel import ShmBufferedChannel
+    from ray_tpu.dag.compiled_dag import _Stage
+    from ray_tpu.exceptions import ChannelError, ChannelTimeoutError
+
+    try:
+        chans = {cid: ShmBufferedChannel.attach(store, spec)
+                 for cid, spec in desc["channels"].items()}
+        stages = []
+        for sd in desc["stages"]:
+            sources = []
+            for kind, a, b in sd["arg_sources"]:
+                if kind == "const":
+                    sources.append(("const", pickle.loads(a), None))
+                else:
+                    sources.append(("chan", chans[a], b))
+            stages.append(_Stage(
+                node=None, fn=None, arg_sources=sources,
+                out_channel=chans[sd["out_channel"]],
+                method_name=sd["method_name"]))
+        while True:
+            try:
+                for stage in stages:
+                    stage.run_once(actor_instance)
+            except ChannelTimeoutError:
+                if os.getppid() == 1:
+                    return  # orphaned: the driver died without teardown
+                continue  # producer/consumer slow: retry
+            except ChannelError:
+                return  # teardown closed the channels
+    except BaseException:  # noqa: BLE001 — log, never propagate
+        print("ray_tpu compiled-DAG worker loop failed:\n"
+              + traceback.format_exc(), file=sys.stderr, flush=True)
+
+
 def worker_loop(store_name: str, req_id: int, rep_id: int,
                 worker_id: int, max_msg: int,
                 api_req_id: int = 0, api_rep_id: int = 0) -> None:
@@ -262,6 +304,25 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                         max_workers=max(int(max_concurrency), 1),
                         thread_name_prefix="actor-call")
                 _reply(("ok", None))
+            elif kind == "dag_exec":
+                # Compiled-DAG shm plane (reference: do_exec_tasks over
+                # NCCL/shm channels): run this actor's static stage
+                # schedule INSIDE the worker, reading/writing native shm
+                # channels directly — the driver never touches the
+                # inter-stage payloads. Blocks until the DAG tears down
+                # (channels closed), which is the "DAG occupies the
+                # actor" semantic; the reply releases the caller.
+                try:
+                    desc = pickle.loads(_fetch_blob(store, msg[1]))
+                    _run_dag_stages(store, desc, actor_instance)
+                except BaseException:  # noqa: BLE001 — must not reach the
+                    # outer error boundary: that would send a SECOND reply
+                    # and desync every later request on this worker.
+                    print("ray_tpu dag_exec setup failed:\n"
+                          + traceback.format_exc(), file=sys.stderr,
+                          flush=True)
+                finally:
+                    _reply(("ok", None))
             elif kind == "actor_submit":
                 (_, call_id, method_name, payload, return_keys,
                  num_returns, task_id_bin, name) = msg

@@ -800,6 +800,55 @@ class _ActorRuntime:
         with self._lock:
             self._mailbox.put(_ClosureCall(fn))
 
+    def start_dag_loop(self, desc_bytes: bytes, teardown_event):
+        """Ship a compiled-DAG stage schedule INTO this actor's worker
+        process (worker_main "dag_exec"): stages execute worker-resident
+        over native shm channels — the driver never touches the
+        inter-stage payloads (the NCCL-channel analogue for same-host
+        worker processes). The mailbox closure occupies the actor until
+        the DAG tears down, matching driver-plane semantics."""
+        from ray_tpu._private.worker_pool import maybe_stage
+
+        worker = global_worker()
+
+        def run(_instance):
+            staged: list = []
+            try:
+                limit = max(self._proc.max_msg // 4, 64 * 1024)
+                field, staged = maybe_stage(
+                    worker.shm_store, desc_bytes, limit)
+                if self.use_mux:
+                    # The pump owns the reply channel; fire the request
+                    # raw and hold the mailbox until teardown.
+                    self._proc._req.write(("dag_exec", field),
+                                          timeout=60.0)
+                    teardown_event.wait()
+                else:
+                    # Blocks until the worker's DAG loop exits (channels
+                    # closed at teardown) — occupation by construction.
+                    self._proc.request(("dag_exec", field))
+            except Exception as exc:  # noqa: BLE001 — crash boundary
+                # A dispatch failure means the worker never started its
+                # stage loop: the DAG would hang silently. Record it and
+                # shout — the user's next execute() timeout has a cause.
+                self._dag_loop_error = exc
+                if not teardown_event.is_set():
+                    import sys
+                    import traceback as _tb
+
+                    print(f"ray_tpu: compiled-DAG loop for actor "
+                          f"{self.class_name!r} failed to start: "
+                          f"{_tb.format_exc()}", file=sys.stderr,
+                          flush=True)
+            finally:
+                for key in staged:
+                    try:
+                        worker.shm_store.delete(key)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self.submit_exec_loop(run)
+
     # ------------------------------------------------------------- lifecycle
     def terminate(self, no_restart: bool = True):
         if self.dead and no_restart:

@@ -14,6 +14,7 @@ from ray_tpu.channels.channel import (
     Channel,
     CompositeChannel,
     IntraProcessChannel,
+    ShmBufferedChannel,
 )
 
 
@@ -41,4 +42,5 @@ __all__ = [
     "CompositeChannel",
     "IntraProcessChannel",
     "SharedMemoryChannel",
+    "ShmBufferedChannel",
 ]

@@ -143,6 +143,75 @@ class BufferedChannel(Channel):
             s.close()
 
 
+class ShmBufferedChannel(Channel):
+    """Cross-process buffered channel: a ring of native shared-memory
+    mutable objects (reference role: BufferedSharedMemoryChannel over
+    plasma mutable objects — the transport that keeps the driver out of
+    the data path between worker-process DAG stages).
+
+    Every participating process constructs its own instance over the SAME
+    slot ids (``create=True`` only in the allocating driver). Cursor
+    state is process-local, which is sound because each edge has exactly
+    one writer process and each reader_id lives in exactly one process.
+    A timed-out read/write leaves cursors unmoved, so compiled-DAG
+    partial-progress retries resume cleanly."""
+
+    def __init__(self, store, slot_ids: List[int], max_size: int,
+                 num_readers: int = 1, create: bool = True):
+        from ray_tpu._native.store import NativeMutableChannel
+
+        self.slot_ids = list(slot_ids)
+        self.max_size = max_size
+        self.num_readers = num_readers
+        self._slots = [
+            NativeMutableChannel(store, sid, max_size=max_size,
+                                 num_readers=num_readers, create=create)
+            for sid in slot_ids
+        ]
+        self._w = 0
+        self._r = [0] * num_readers
+
+    def spec(self) -> tuple:
+        """Wire description a peer process rebuilds the channel from."""
+        return (tuple(self.slot_ids), self.max_size, self.num_readers)
+
+    @classmethod
+    def attach(cls, store, spec: tuple) -> "ShmBufferedChannel":
+        slot_ids, max_size, num_readers = spec
+        return cls(store, list(slot_ids), max_size, num_readers,
+                   create=False)
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        from ray_tpu._native.store import NativeError
+
+        slot = self._slots[self._w % len(self._slots)]
+        try:
+            slot.write(value, timeout)
+        except NativeError as e:
+            if e.code == -3:  # payload exceeds the slot capacity
+                raise ChannelError(
+                    f"compiled-DAG payload exceeds the shm channel "
+                    f"capacity ({self.max_size} bytes): compile with "
+                    f"channel_bytes=<larger> or "
+                    f"with_tensor_transport('driver')") from None
+            raise
+        self._w += 1  # advance only after success (retry-safe)
+
+    def read(self, reader_id: int = 0, timeout: Optional[float] = None):
+        slot = self._slots[self._r[reader_id] % len(self._slots)]
+        value = slot.read(reader_id, timeout)
+        self._r[reader_id] += 1
+        return value
+
+    def close(self):
+        for s in self._slots:
+            s.close()
+
+    def destroy(self):
+        for s in self._slots:
+            s.destroy()
+
+
 class CompositeChannel(Channel):
     """Fans one writer out to several underlying channels (the reference
     uses this to split local vs remote readers)."""

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import atexit
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -108,9 +109,13 @@ class _Stage:
 
 class CompiledDAG:
     def __init__(self, leaf: DAGNode, max_buffered_executions: int = 2,
-                 **_options):
+                 channel_bytes: Optional[int] = None, **_options):
         self._leaf = leaf
         self._buffer = max(int(max_buffered_executions), 1)
+        # Shm-plane slot capacity. Payloads above it fail the write with
+        # an explicit ChannelError naming this knob (the driver plane has
+        # no such cap — with_tensor_transport('driver') opts out).
+        self._channel_bytes = channel_bytes
         self._lock = threading.Lock()
         self._read_lock = threading.Lock()
         self._exec_count = 0
@@ -159,14 +164,22 @@ class CompiledDAG:
         else:
             _count_consumer(self._leaf)
 
+        # Transport selection (with_tensor_transport hints, reference:
+        # TorchTensorType(transport=...)): "shm" runs every actor stage's
+        # exec loop INSIDE its worker process with native shared-memory
+        # channels on every edge — inter-stage payloads never touch the
+        # driver. Eligible when all actor stages are process-backed sync
+        # actors; "driver" (or ineligibility under "auto") keeps the
+        # driver-hosted python channel plane.
+        self._shm_mode = self._select_transport(order, exec_nodes)
+
         # Channels per node output (input node included).
-        self._channels: Dict[int, BufferedChannel] = {}
+        self._channels: Dict[int, Any] = {}
         reader_cursor: Dict[int, int] = {}
         for node in order:
             n = consumers.get(id(node), 0)
             if n > 0 and not isinstance(node, (MultiOutputNode, ClassNode)):
-                self._channels[id(node)] = BufferedChannel(
-                    num_readers=n, buffer_count=self._buffer)
+                self._channels[id(node)] = self._make_channel(n)
                 reader_cursor[id(node)] = 0
 
         def _source_for(a):
@@ -190,7 +203,7 @@ class CompiledDAG:
             out_ch = self._channels.get(id(node))
             if out_ch is None:
                 # Leaf with no consumers shouldn't happen (leaf counted).
-                out_ch = BufferedChannel(1, self._buffer)
+                out_ch = self._make_channel(1)
             method_name = ""
             if isinstance(node, FunctionNode):
                 fn = node.function
@@ -231,10 +244,16 @@ class CompiledDAG:
             self._multi_output = False
 
         # Start execution loops. Driver-side stages run on a dedicated
-        # thread; actor stages are submitted INTO the actor's mailbox as one
-        # long-running closure (reference do_exec_tasks parity) so they
-        # execute on the actor's own loop thread, serialized with — and
-        # blocking — normal .remote() calls until teardown.
+        # thread. Actor stages:
+        # - driver channel plane: a long-running closure in the actor's
+        #   mailbox (reference do_exec_tasks parity) executing on the
+        #   actor's loop thread (process actors via the proxy);
+        # - shm plane: the stage schedule ships INTO the worker process
+        #   (worker_main "dag_exec") and runs there over the native
+        #   channels — payloads never touch the driver. The mailbox still
+        #   gets an occupying closure, so normal .remote() calls queue
+        #   behind the DAG exactly like the driver plane.
+        self._teardown_event = threading.Event()
         self._threads: List[threading.Thread] = []
         for key, stages in self._loops.items():
             if key == "__driver__":
@@ -243,10 +262,102 @@ class CompiledDAG:
                     name="compiled-dag-loop-driver")
                 t.start()
                 self._threads.append(t)
+            elif self._shm_mode:
+                key.start_dag_loop(self._stage_descriptor(stages),
+                                   self._teardown_event)
             else:
                 key.submit_exec_loop(
                     lambda instance, stages=stages:
                     self._exec_loop(stages, instance))
+
+    def _stage_descriptor(self, stages: List[_Stage]) -> bytes:
+        """Wire form of one actor's stage schedule for the worker-resident
+        exec loop: channel specs + per-stage sources/sinks."""
+        import pickle
+
+        channels: Dict[int, tuple] = {}
+
+        def _cid(ch) -> int:
+            cid = ch.slot_ids[0]
+            channels[cid] = ch.spec()
+            return cid
+
+        descs = []
+        for stage in stages:
+            sources = []
+            for kind, a, b in stage.arg_sources:
+                if kind == "const":
+                    sources.append(("const", pickle.dumps(a, protocol=5),
+                                    None))
+                else:
+                    sources.append(("chan", _cid(a), b))
+            descs.append({
+                "method_name": stage.method_name,
+                "arg_sources": sources,
+                "out_channel": _cid(stage.out_channel),
+            })
+        return pickle.dumps({"channels": channels, "stages": descs},
+                            protocol=5)
+
+    def _select_transport(self, order, exec_nodes) -> bool:
+        hints = {getattr(n, "_transport_hint", "auto") for n in order}
+        want_shm = "shm" in hints
+        want_driver = "driver" in hints
+        if want_shm and want_driver:
+            raise ValueError(
+                "conflicting tensor transports: both 'shm' and 'driver' "
+                "hinted in one DAG")
+        if want_driver:
+            return False
+        from ray_tpu._private.worker import global_worker
+
+        worker = global_worker()
+        eligible = getattr(worker, "shm_store", None) is not None
+        if eligible:
+            for node in exec_nodes:
+                if not isinstance(node, ClassMethodNode):
+                    continue  # driver-thread stages work over shm too
+                rt = node._bound_method()._runtime
+                if not rt.use_process or rt.is_async:
+                    eligible = False
+                    break
+        if want_shm and not eligible:
+            raise ValueError(
+                "with_tensor_transport('shm') requires every actor stage "
+                "to be a process-backed sync actor and the native shm "
+                "store to be available")
+        return eligible
+
+    def _make_channel(self, num_readers: int):
+        if not self._shm_mode:
+            return BufferedChannel(
+                num_readers=num_readers, buffer_count=self._buffer)
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.channels import ShmBufferedChannel
+
+        slot_ids = [self._next_chan_id() for _ in range(self._buffer)]
+        ch = ShmBufferedChannel(
+            global_worker().shm_store, slot_ids,
+            max_size=(self._channel_bytes
+                      or GlobalConfig.channel_buffer_bytes),
+            num_readers=num_readers, create=True)
+        return ch
+
+    _chan_counter = [0]
+    _chan_lock = threading.Lock()
+
+    @classmethod
+    def _next_chan_id(cls) -> int:
+        # Reserved 0xDA6… range: never collides with worker channels
+        # (0xC…), staging (0xA…), or hashed object keys (top nibble 0).
+        import os
+
+        with cls._chan_lock:
+            cls._chan_counter[0] += 1
+            return (0xDA60_0000_0000_0000
+                    | (os.getpid() & 0xFFFF) << 24
+                    | (cls._chan_counter[0] & 0xFF_FFFF))
 
     def _exec_loop(self, stages: List[_Stage], instance):
         """do_exec_tasks parity: run the static schedule until teardown.
@@ -310,7 +421,16 @@ class CompiledDAG:
 
     def teardown(self):
         self._torn_down = True
+        if getattr(self, "_teardown_event", None) is not None:
+            self._teardown_event.set()
         for ch in self._channels.values():
             ch.close()
         for t in self._threads:
             t.join(timeout=2)
+        if getattr(self, "_shm_mode", False):
+            # Worker loops exit on the closed channels; reclaim the shm
+            # arena afterwards (a straggler mid-read observes CLOSED).
+            time.sleep(0.05)
+            for ch in self._channels.values():
+                if hasattr(ch, "destroy"):
+                    ch.destroy()

@@ -21,6 +21,24 @@ class DAGNode:
     def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
         self._bound_args = args
         self._bound_kwargs = kwargs
+        self._transport_hint: str = "auto"
+
+    def with_tensor_transport(self, transport: str = "shm") -> "DAGNode":
+        """Type-hint this node's OUTPUT edge transport (reference parity:
+        ``with_type_hint(TorchTensorType(transport="nccl"))``).
+
+        - ``"shm"``: require the zero-driver-copy shared-memory channel
+          plane (worker-resident exec loops); compile fails if any stage
+          cannot run in a worker process.
+        - ``"driver"``: force driver-hosted python channels (for payloads
+          that must share driver memory, e.g. live jax device arrays).
+        - ``"auto"`` (default): shm when every actor stage is
+          process-backed, driver channels otherwise.
+        """
+        if transport not in ("shm", "driver", "auto"):
+            raise ValueError(f"unknown transport {transport!r}")
+        self._transport_hint = transport
+        return self
 
     # ---------------------------------------------------------------- deps
     def _upstream(self) -> List["DAGNode"]:

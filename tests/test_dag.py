@@ -432,3 +432,87 @@ def test_actor_dag_channels_preserve_device_residency(ray_start_regular):
         assert out is arr  # by-reference end to end: zero copies
     finally:
         compiled.teardown()
+
+
+def test_actor_dag_shm_plane_keeps_driver_out_of_data_path():
+    """Process-actor pipelines compile onto the shm channel plane
+    (reference: TorchTensorType(transport=...) channels): stage loops run
+    INSIDE the worker processes over native shared-memory channels, the
+    payload round-trips intact, and the driver hosts no python channel
+    for any edge."""
+    import numpy as np
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, worker_mode="process",
+                 ignore_reinit_error=True)
+    from ray_tpu.channels import ShmBufferedChannel
+
+    @ray_tpu.remote
+    class Scale:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            import os
+
+            return {"data": x["data"] * self.k,
+                    "pids": x["pids"] + [os.getpid()]}
+
+    a, b = Scale.remote(2.0), Scale.remote(3.0)
+    with InputNode() as inp:
+        dag = b.apply.bind(a.apply.bind(inp))
+    compiled = dag.experimental_compile(backend="actor")
+    try:
+        assert compiled._shm_mode
+        # Every edge rides a native shm channel, none a driver channel.
+        assert all(isinstance(ch, ShmBufferedChannel)
+                   for ch in compiled._channels.values())
+        arr = np.arange(1024, dtype=np.float32)
+        out = compiled.execute({"data": arr, "pids": []}).get(timeout=30)
+        assert np.allclose(out["data"], arr * 6.0)
+        # The stages really ran in two distinct worker processes, neither
+        # of which is the driver.
+        import os
+
+        pids = set(out["pids"])
+        assert len(pids) == 2 and os.getpid() not in pids
+    finally:
+        compiled.teardown()
+    ray_tpu.shutdown()
+
+
+def test_actor_dag_transport_hints():
+    """with_tensor_transport: 'driver' forces the python channel plane;
+    'shm' on an ineligible DAG (driver-runtime actor) raises."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, worker_mode="process",
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    class P:
+        def apply(self, x):
+            return x + 1
+
+    @ray_tpu.remote(runtime="driver")
+    class D:
+        def apply(self, x):
+            return x + 1
+
+    p = P.remote()
+    with InputNode() as inp:
+        dag = p.apply.bind(inp).with_tensor_transport("driver")
+    compiled = dag.experimental_compile(backend="actor")
+    try:
+        assert not compiled._shm_mode
+        assert compiled.execute(1).get(timeout=30) == 2
+    finally:
+        compiled.teardown()
+
+    d = D.remote()
+    with InputNode() as inp:
+        dag2 = d.apply.bind(inp).with_tensor_transport("shm")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="process-backed"):
+        dag2.experimental_compile(backend="actor")
+    ray_tpu.shutdown()
