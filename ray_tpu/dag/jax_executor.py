@@ -83,7 +83,6 @@ class CompiledJaxDAG:
                  num_tasks: int, num_waves: int, wave_width: int,
                  payload_shape, dtype, dynamic: bool, op_names: List[str],
                  num_shards: int = 1):
-        self._fn = fn
         self.num_inputs = num_inputs
         self.multi_output = multi_output
         self.num_tasks = num_tasks
@@ -94,19 +93,42 @@ class CompiledJaxDAG:
         self.dynamic = dynamic
         self.op_names = op_names
         self.num_shards = num_shards
+        # Input staging lives INSIDE the jit: eager jnp.asarray on a host
+        # scalar is a blocking device_put (tens of ms through a tunnel),
+        # while the same scalar passed as a jit argument rides the cheap
+        # dispatch path. Host-side cost per execute drops from ~ms to ~µs.
+        payload_shape_t = self.payload_shape
+        dtype_t = self.dtype
+
+        if num_inputs:
+            @jax.jit
+            def staged(*raw):
+                stacked = jnp.stack(
+                    [jnp.asarray(x, dtype=dtype_t).reshape(payload_shape_t)
+                     for x in raw])
+                return fn(stacked)
+        else:
+            @jax.jit
+            def staged():
+                return fn(jnp.zeros((0,) + payload_shape_t, dtype_t))
+
+        self._staged = staged
 
     def execute(self, *inputs) -> JaxDAGRef:
         if len(inputs) != self.num_inputs:
             raise ValueError(
                 f"compiled DAG takes {self.num_inputs} input(s), got "
                 f"{len(inputs)}")
-        if self.num_inputs:
-            stacked = jnp.stack(
-                [jnp.asarray(x, dtype=self.dtype).reshape(self.payload_shape)
-                 for x in inputs])
-        else:
-            stacked = jnp.zeros((0,) + self.payload_shape, self.dtype)
-        out = self._fn(stacked)
+        # Non-device inputs normalize to host numpy in the payload dtype —
+        # free on host — so every call shares ONE jit signature (a Python
+        # int one call and a float the next must not retrace the whole DAG
+        # program). Device arrays pass through zero-copy; any dtype cast
+        # happens inside the trace.
+        prepped = [
+            x if isinstance(x, jax.Array)
+            else np.asarray(x, dtype=self.dtype) for x in inputs
+        ]
+        out = self._staged(*prepped)
         return JaxDAGRef(out, self.multi_output)
 
     def __call__(self, *inputs):
@@ -345,13 +367,16 @@ def compile_jax_dag(
                     idx[id(f)] = len(uniq)
                     uniq.append(f)
                 seq.append(idx[id(f)])
-            seq_arr = jnp.asarray(np.asarray(seq, np.int32))
+            seq_np = np.asarray(seq, np.int32)
 
             def macro(*args):
                 x = head_fn(*args)
+                # Trace-time literal, NOT an eager device array: a closure
+                # device const forces a buffer sync per dispatch batch on
+                # tunneled backends (~100 ms); an HLO literal is free.
                 return lax.scan(
                     lambda c, o: (lax.switch(o, uniq, c), None),
-                    x, seq_arr)[0]
+                    x, jnp.asarray(seq_np))[0]
         return macro
 
     fused: List[Tuple[Callable, List[int], int, int, str]] = []
@@ -412,20 +437,23 @@ def compile_jax_dag(
         _make_branch(fn, ar) for fn, ar in zip(op_fns, arity_of)
     ]
     single_op = len(branches) == 1
-    arg_slots_dev = jnp.asarray(arg_slots)
-    out_slots_dev = jnp.asarray(out_slots)
-    op_ids_dev = jnp.asarray(op_ids)
+    # Schedule tables stay host numpy until trace time: jnp.asarray inside a
+    # trace emits an HLO literal (free), while an eagerly-created device
+    # array captured by the jit closure becomes a runtime parameter whose
+    # buffer the tunneled backend re-syncs every dispatch batch (~100 ms
+    # stall per block_until_ready on axon). Measured: literal tables run a
+    # 1k-task chain at ~40 µs/exec; device-const tables at ~11 ms/exec.
 
     def _compute_tasks(obj, t_idx):
         """Run tasks t_idx (int32 [W], -1 = padding) → outputs [W, *P]."""
         valid = t_idx >= 0
         t = jnp.where(valid, t_idx, 0)
-        a_slots = arg_slots_dev[t]                      # [W, A]
+        a_slots = jnp.asarray(arg_slots)[t]             # [W, A]
         stacked = obj[a_slots]                          # [W, A, *P]
         if single_op:
             outs = jax.vmap(branches[0])(stacked)       # [W, *P]
         else:
-            ops = op_ids_dev[t]
+            ops = jnp.asarray(op_ids)[t]
             outs = jax.vmap(
                 lambda o, s: lax.switch(o, branches, s))(ops, stacked)
         return outs
@@ -435,7 +463,7 @@ def compile_jax_dag(
         outs = _compute_tasks(obj, t_idx)
         valid = t_idx >= 0
         t = jnp.where(valid, t_idx, 0)
-        slots = jnp.where(valid, out_slots_dev[t], scratch_slot)
+        slots = jnp.where(valid, jnp.asarray(out_slots)[t], scratch_slot)
         return obj.at[slots].set(outs)
 
     # Dependency structure over the compact task list (slot-level).
@@ -461,18 +489,17 @@ def compile_jax_dag(
             sched[wi, : len(w)] = w
 
         if mesh is None:
-            sched_dev = jnp.asarray(sched)
-
             def program(inputs):
+                sched_c = jnp.asarray(sched)   # trace-time literal
                 obj = jnp.zeros((num_slots,) + payload_shape, dtype)
                 if num_inputs:
                     obj = obj.at[:num_inputs].set(inputs)
                 if num_waves == 1:
-                    obj = _run_tasks(obj, sched_dev[0])
+                    obj = _run_tasks(obj, sched_c[0])
                 else:
                     obj = lax.fori_loop(
                         0, num_waves,
-                        lambda w, o: _run_tasks(o, sched_dev[w]), obj)
+                        lambda w, o: _run_tasks(o, sched_c[w]), obj)
                 out = obj[jnp.asarray(leaf_slots)]
                 return out if multi_output else out[0]
 
@@ -562,16 +589,17 @@ def compile_jax_dag(
                         exp_idx_sh[sh, wi, k] = lane_of[ci][1]
                         exp_slots[wi, sh * max(X_max, 1) + k] = out_slots[ci]
 
-            sched_dev_sh = jnp.asarray(sched_sh)
-            own_dev_sh = jnp.asarray(own_slots_sh)
-            exp_idx_dev_sh = jnp.asarray(exp_idx_sh)
-            exp_slots_dev = jnp.asarray(exp_slots)
             wave_width = Wn * n_sh
 
-            def _sharded_static(inputs, sched_local, own_local, expi_local):
-                sched_l = sched_local[0]                 # [num_waves, Wn]
-                own_l = own_local[0]
-                expi_l = expi_local[0]
+            def _sharded_static(inputs):
+                # Every schedule table enters as a trace-time literal,
+                # indexed by this shard's axis position — never as a
+                # sharded runtime argument or closure device const (see
+                # the literal-vs-device-const note at _compute_tasks).
+                sh = lax.axis_index(mesh_axis)
+                sched_l = jnp.asarray(sched_sh)[sh]      # [num_waves, Wn]
+                own_l = jnp.asarray(own_slots_sh)[sh]
+                expi_l = jnp.asarray(exp_idx_sh)[sh]
                 obj = jnp.zeros((num_slots,) + payload_shape, dtype)
                 if num_inputs:
                     obj = obj.at[:num_inputs].set(inputs)
@@ -583,7 +611,7 @@ def compile_jax_dag(
                         exp = outs[expi_l[w]]                  # [X_max, *P]
                         gathered = lax.all_gather(
                             exp, mesh_axis, axis=0, tiled=True)
-                        o = o.at[exp_slots_dev[w]].set(gathered)
+                        o = o.at[jnp.asarray(exp_slots)[w]].set(gathered)
                     return o
 
                 if num_waves == 1:
@@ -595,12 +623,11 @@ def compile_jax_dag(
 
             sharded_fn = jax.jit(jax.shard_map(
                 _sharded_static, mesh=mesh,
-                in_specs=(P(), P(mesh_axis), P(mesh_axis), P(mesh_axis)),
+                in_specs=(P(),),
                 out_specs=P(), check_vma=False))
 
             def program(inputs):
-                return sharded_fn(inputs, sched_dev_sh, own_dev_sh,
-                                  exp_idx_dev_sh)
+                return sharded_fn(inputs)
 
             program.export_width = X_max
             program.lanes_per_shard = Wn
@@ -618,14 +645,18 @@ def compile_jax_dag(
                     edges_src.append(src)
                     edges_dst.append(ci)
                     indeg0[ci] += 1
-        e_src = jnp.asarray(np.asarray(edges_src, np.int32))
-        e_dst = jnp.asarray(np.asarray(edges_dst, np.int32))
-        all_tasks = jnp.arange(C, dtype=jnp.int32)
+        e_src_np = np.asarray(edges_src, np.int32)
+        e_dst_np = np.asarray(edges_dst, np.int32)
         num_waves = 0  # unknown statically
         wave_width = C
 
         if mesh is None:
             def program(inputs):
+                # All tables enter the trace as literals (see the note at
+                # _compute_tasks) — never as closure device arrays.
+                e_src = jnp.asarray(e_src_np)
+                e_dst = jnp.asarray(e_dst_np)
+                all_tasks = jnp.arange(C, dtype=jnp.int32)
                 obj = jnp.zeros((num_slots,) + payload_shape, dtype)
                 if num_inputs:
                     obj = obj.at[:num_inputs].set(inputs)
@@ -644,7 +675,7 @@ def compile_jax_dag(
                     done = done | ready
                     # Frontier expansion: decrement consumers of finished
                     # producers via a segment-sum over the edge list.
-                    if e_src.shape[0]:
+                    if e_src_np.shape[0]:
                         fired = ready[e_src].astype(jnp.int32)
                         indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
                             fired)
@@ -676,12 +707,12 @@ def compile_jax_dag(
             indeg0_pad[:C] = indeg0
             done0_pad = np.zeros(C_pad, bool)
             done0_pad[C:] = True  # padding tasks are born finished
-            ids_sharded = jnp.asarray(
-                np.arange(C_pad, dtype=np.int32).reshape(n_sh, Cn))
-            out_slots_ext_dev = jnp.asarray(out_slots_ext)
+            ids_np = np.arange(C_pad, dtype=np.int32).reshape(n_sh, Cn)
 
-            def _sharded_dynamic(inputs, my_ids):
-                my_ids = my_ids[0]                       # [Cn]
+            def _sharded_dynamic(inputs):
+                # Owned-task ids as a trace-time literal indexed by shard
+                # position (see the literal note at _compute_tasks).
+                my_ids = jnp.asarray(ids_np)[lax.axis_index(mesh_axis)]
                 obj = jnp.zeros((num_slots,) + payload_shape, dtype)
                 if num_inputs:
                     obj = obj.at[:num_inputs].set(inputs)
@@ -709,14 +740,15 @@ def compile_jax_dag(
                     g_ids = lax.all_gather(
                         jnp.where(valid, chosen, C_pad), mesh_axis,
                         axis=0, tiled=True)              # [nF]
-                    obj = obj.at[out_slots_ext_dev[g_ids]].set(g_outs)
+                    obj = obj.at[jnp.asarray(out_slots_ext)[g_ids]].set(
+                        g_outs)
                     fired = (jnp.zeros(C_pad + 1, bool).at[g_ids].set(True)
                              )[:C_pad]
                     done = done | fired
-                    if e_src.shape[0]:
-                        hit = fired[e_src].astype(jnp.int32)
-                        indeg = indeg - jnp.zeros_like(indeg).at[e_dst].add(
-                            hit)
+                    if e_src_np.shape[0]:
+                        hit = fired[jnp.asarray(e_src_np)].astype(jnp.int32)
+                        indeg = indeg - jnp.zeros_like(indeg).at[
+                            jnp.asarray(e_dst_np)].add(hit)
                     return obj, indeg, done
 
                 obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
@@ -725,11 +757,11 @@ def compile_jax_dag(
 
             sharded_fn = jax.jit(jax.shard_map(
                 _sharded_dynamic, mesh=mesh,
-                in_specs=(P(), P(mesh_axis, None)),
+                in_specs=(P(),),
                 out_specs=P(), check_vma=False))
 
             def program(inputs):
-                return sharded_fn(inputs, ids_sharded)
+                return sharded_fn(inputs)
 
             program.export_width = F
             program.lanes_per_shard = Cn
