@@ -31,25 +31,56 @@ def _time_executions(compiled, n_iters, *args):
     return times
 
 
-def _time_pipelined(compiled, n_iters, *args):
-    """Amortized per-execution time: dispatch n executions asynchronously,
-    block once at the end. This measures device throughput rather than the
-    host<->device round-trip latency of a single synchronous get.
+# --- Honest timing on the tunneled TPU backend -------------------------------
+#
+# Two measured properties of this backend shape every timing decision here:
+#
+#   1. Before the process's first device->host readback, the tunnel runs
+#      fire-and-forget: block_until_ready() and is_ready() return
+#      IMMEDIATELY even for multi-second computations (verified: a 19.6 s
+#      matmul loop "blocked" in 0.000 s). The ONLY honest completion
+#      signal is a readback (float()/np.asarray on a result).
+#   2. The first readback permanently switches the process to synchronous
+#      dispatch (~11 ms floor per call) — so one readback per process, at
+#      the very end of the timed region.
+#
+# Therefore every device-throughput number below is a TWO-POINT MARGINAL:
+# run N_small and N_big data-dependent executions in separate fresh
+# processes, each wall-clocked from first dispatch to a single final
+# readback, and report (wall_big - wall_small) / (N_big - N_small).
+# Trace + compile + process startup + the readback round trip are the same
+# constants in both walls and cancel; data-dependence (each execution
+# consumes the previous result) forces true serialization on the device.
 
-    IMPORTANT ordering constraint (measured on the tunneled TPU backend):
-    the FIRST device->host readback (np.asarray/float on a result)
-    permanently degrades every subsequent async dispatch in the process
-    from ~40 µs to ~11 ms. All pipelined timing must therefore run before
-    any .get()/parity readback, and each suite runs in its own process
-    (see main) so one suite's readbacks can't poison another's numbers."""
-    import jax
 
-    ref = None
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        ref = compiled.execute(*args)
-    jax.block_until_ready(ref.device_value())
-    return (time.perf_counter() - t0) / n_iters
+def _run_probe(probe, n, extra=(), timeout=900):
+    """Spawn one fresh-process probe measurement; returns its JSON line."""
+    import os
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--probe", probe,
+         "--probe-n", str(n), *extra],
+        capture_output=True, text=True, timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"probe {probe} n={n} failed: {out.stderr[-1500:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _marginal_times(probe, n_small, n_big, repeats, extra=()):
+    """Per-iteration marginal times: Theil-Sen slopes over `repeats`
+    fresh-process walls at each of the two sizes. The median of ALL
+    cross-pair slopes is robust to a single slow process (tunnel
+    reconnect, compile-cache miss), which a plain per-pair difference
+    is not."""
+    _run_probe(probe, 2, extra)  # warm the backend compile cache, untimed
+    small, big = [], []
+    for _ in range(repeats):
+        small.append(_run_probe(probe, n_small, extra)["wall_s"])
+        big.append(_run_probe(probe, n_big, extra)["wall_s"])
+    span = n_big - n_small
+    return sorted((wb - ws) / span for ws in small for wb in big)
 
 
 def _median_iqr(vals):
@@ -64,8 +95,7 @@ def _median_iqr(vals):
     return med, iqr
 
 
-def bench_chain(n_tasks=1000, n_iters=500, repeats=9):
-    """Config #1: single-node no-op task chain."""
+def _build_chain_dag(n_tasks=1000):
     from ray_tpu.dag import InputNode
     import ray_tpu
 
@@ -77,48 +107,10 @@ def bench_chain(n_tasks=1000, n_iters=500, repeats=9):
         node = inp
         for _ in range(n_tasks):
             node = noop.bind(node)
-    import jax
-
-    compiled = node.experimental_compile(backend="jax")
-    # Warmup/compile WITHOUT a host readback — a readback here would poison
-    # every timed dispatch below (see _time_pipelined).
-    jax.block_until_ready(compiled.execute(0.0).device_value())
-    _time_pipelined(compiled, n_iters, 0.0)  # untimed dispatch-path warmup
-    per_repeat = [_time_pipelined(compiled, n_iters, 0.0)
-                  for _ in range(repeats)]
-    rates = [n_tasks / t for t in per_repeat]
-    rate_med, rate_iqr = _median_iqr(rates)
-    amortized = statistics.median(per_repeat)
-    # Parity readback + measured synchronous end-to-end latency (execute +
-    # blocking get). These run LAST: the first readback flips the tunnel
-    # into degraded-dispatch mode, which is also why sync latency is
-    # tunnel-dominated — the device itself finished in `task_latency_us *
-    # n_tasks`.
-    assert float(compiled.execute(0.5).get()) == 0.5
-    sync = _time_executions(compiled, max(2 * repeats, 10), 0.0)
-    sync.sort()
-    sync_p50_us = sync[len(sync) // 2] * 1e6
-    device_us = amortized * 1e6
-    return {
-        "suite": "chain_1k_noop",
-        "tasks_per_sec": rate_med,
-        "tasks_per_sec_iqr": rate_iqr,
-        "repeats": repeats,
-        "task_latency_us": amortized / n_tasks * 1e6,
-        "sync_exec_p50_us": sync_p50_us,
-        "sync_exec_p99_us": sync[min(len(sync) - 1,
-                                     int(len(sync) * 0.99))] * 1e6,
-        # Breakdown of the sync p50: on-device execution vs host<->device
-        # tunnel round trip (readback + degraded-mode dispatch).
-        "sync_device_us": device_us,
-        "sync_tunnel_overhead_us": max(0.0, sync_p50_us - device_us),
-        "wall_s_per_exec": amortized,
-        "num_tasks": n_tasks,
-    }
+    return node.experimental_compile(backend="jax")
 
 
-def bench_fanout(width=10_000, n_iters=500, repeats=9):
-    """Config #2: wide fan-out -> fan-in reduce."""
+def _build_fanout_dag(width=10_000):
     from ray_tpu.dag import InputNode, reduce_tree
     import ray_tpu
 
@@ -136,29 +128,59 @@ def bench_fanout(width=10_000, n_iters=500, repeats=9):
     with InputNode() as inp:
         leaves = [noop.bind(inp) for _ in range(width)]
         root = reduce_tree(combine, leaves, arity=4)
-    import jax
+    return root.experimental_compile(backend="jax")
 
-    compiled = root.experimental_compile(backend="jax")
-    n_total = compiled.num_tasks
-    # Warmup readback-free; the parity .get() runs after timing (a readback
-    # here would poison the timed dispatches — see _time_pipelined).
-    jax.block_until_ready(compiled.execute(1.0).device_value())
-    _time_pipelined(compiled, n_iters, 1.0)  # untimed dispatch-path warmup
-    per_repeat = [_time_pipelined(compiled, n_iters, 1.0)
-                  for _ in range(repeats)]
-    out = compiled.execute(1.0).get()
-    assert float(out) == float(width), f"fan-in parity: {out} != {width}"
-    rates = [n_total / t for t in per_repeat]
+
+def bench_chain(n_tasks=1000, repeats=9):
+    """Config #1: single-node no-op task chain. Marginal-timed (see the
+    honest-timing note at _run_probe): each repeat is a fresh-process pair
+    of 2000 vs 50000 data-dependent executions ending in one readback."""
+    margs = _marginal_times("chain", 2000, 50000, repeats)
+    rates = [n_tasks / m for m in margs]
     rate_med, rate_iqr = _median_iqr(rates)
-    amortized = statistics.median(per_repeat)
+    per_exec = statistics.median(margs)
+    # Synchronous end-to-end latency: execute + blocking get, measured in
+    # the tunnel's post-readback synchronous mode (a separate probe).
+    sync = _run_probe("chain_sync", 10)
+    sync_p50_us = sync["p50_s"] * 1e6
+    device_us = per_exec * 1e6
+    return {
+        "suite": "chain_1k_noop",
+        "tasks_per_sec": rate_med,
+        "tasks_per_sec_iqr": rate_iqr,
+        "repeats": repeats,
+        "task_latency_us": per_exec / n_tasks * 1e6,
+        "sync_exec_p50_us": sync_p50_us,
+        "sync_exec_p99_us": sync["p99_s"] * 1e6,
+        # Breakdown of the sync p50: on-device execution (the marginal
+        # per-exec time) vs host<->device tunnel round trip.
+        "sync_device_us": device_us,
+        "sync_tunnel_overhead_us": max(0.0, sync_p50_us - device_us),
+        "wall_s_per_exec": per_exec,
+        "num_tasks": n_tasks,
+        "timing": "two-point marginal, data-dependent execs, "
+                  "single final readback per process",
+    }
+
+
+def bench_fanout(width=10_000, repeats=5):
+    """Config #2: wide fan-out -> fan-in reduce. Marginal-timed like
+    bench_chain (fresh-process pairs of 200 vs 1800 dependent execs)."""
+    margs = _marginal_times("fanout", 200, 1800, repeats)
+    n_total = 13334  # width + ceil-div-4 reduce tree; asserted in probe
+    rates = [n_total / m for m in margs]
+    rate_med, rate_iqr = _median_iqr(rates)
+    per_exec = statistics.median(margs)
     return {
         "suite": "fanout_10k",
         "tasks_per_sec": rate_med,
         "tasks_per_sec_iqr": rate_iqr,
         "repeats": repeats,
-        "task_latency_us": amortized / n_total * 1e6,
-        "wall_s_per_exec": amortized,
+        "task_latency_us": per_exec / n_total * 1e6,
+        "wall_s_per_exec": per_exec,
         "num_tasks": n_total,
+        "timing": "two-point marginal, data-dependent execs, "
+                  "single final readback per process",
     }
 
 
@@ -245,109 +267,145 @@ def _chip_peak_tflops(device) -> float:
     return _PEAK_BF16_TFLOPS["v5e"]  # BASELINE.md target hardware
 
 
-def bench_model_train_step(repeats=5, inner=10):
+def _model_setup(batch, seq):
+    """Shared config/step builder for the model suite + probe."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import TransformerConfig, init_params, loss_fn
+
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=16, d_ff=4096, max_seq_len=seq, dtype=jnp.bfloat16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jax.random.randint(
+        jax.random.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return cfg, params, opt_state, tokens, targets, step
+
+
+def _model_point(batch, seq, repeats, inner=10):
+    """One operating point, timed in-process in the tunnel's synchronous
+    mode: the warmup readback switches dispatch to blocking semantics, so
+    each timed batch of `inner` steps is true wall time (cross-process
+    marginals are too noisy here — the eager 201M-param init dominates
+    probe walls). The per-batch closing readback adds ~90 ms, i.e. the
+    reported step time is conservatively inflated by <=10%."""
+    import jax
+    import numpy as np
+
+    cfg, params, opt_state, tokens, targets, step = _model_setup(batch, seq)
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)  # completes compile AND enters synchronous-dispatch mode
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            params, opt_state, loss = step(
+                params, opt_state, tokens, targets)
+        final = float(loss)  # per-batch readback: honest completion bound
+        times.append((time.perf_counter() - t0) / inner)
+    assert np.isfinite(final), f"loss diverged: {final}"
+    med, iqr = _median_iqr(times)
+
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    tokens_per_step = batch * seq
+    # Training FLOPs: 6*N per token (fwd+bwd matmuls) + attention
+    # 12*L*S*D per token (QK^T + PV, fwd+bwd) — the scaling-book
+    # accounting.
+    flops_per_step = (6 * n_params
+                      + 12 * cfg.n_layers * seq * cfg.d_model
+                      ) * tokens_per_step
+    device = jax.devices()[0]
+    peak = _chip_peak_tflops(device) * 1e12
+    return {
+        "batch": batch, "seq": seq,
+        "n_params": n_params,
+        "step_time_s": med, "step_time_iqr_s": iqr, "repeats": repeats,
+        "tokens_per_sec": tokens_per_step / med,
+        "model_flops_per_step": flops_per_step,
+        "mfu": round(flops_per_step / (med * peak), 4),
+        "peak_tflops_assumed": peak / 1e12,
+    }
+
+
+def bench_model_train_step(repeats=5):
     """Config #6: flagship transformer train step on the accelerator —
-    tokens/sec + MFU vs chip bf16 peak, plus an on-chip numerics check of
-    the Pallas kernels against the dense jax path (SURVEY.md §6)."""
+    tokens/sec + MFU vs chip bf16 peak at TWO operating points (seq 1024
+    where matmuls dominate, seq 4096 where flash attention earns its
+    keep), plus an on-chip numerics check of the Pallas kernels against
+    the dense jax path (SURVEY.md §6). Step times are synchronous-mode
+    in-process walls (see _model_point for why not cross-process
+    marginals)."""
     try:
         import jax
         import jax.numpy as jnp
-        import numpy as np
-        import optax
-
-        from ray_tpu.models import TransformerConfig, init_params, loss_fn
 
         accel = [d for d in jax.devices() if d.platform != "cpu"]
         device = accel[0] if accel else jax.devices()[0]
-        on_accel = bool(accel)
-        batch, seq = 8, 1024
-        cfg = TransformerConfig(
-            vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
-            n_kv_heads=16, d_ff=4096, max_seq_len=seq, dtype=jnp.bfloat16)
-        with jax.default_device(device):
-            params = init_params(cfg, jax.random.PRNGKey(0))
-            opt = optax.adamw(3e-4)
-            opt_state = opt.init(params)
-            tokens = jax.random.randint(
-                jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
-            targets = jax.random.randint(
-                jax.random.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+        points = [_model_point(8, 1024, repeats),
+                  _model_point(2, 4096, repeats)]
 
-            @jax.jit
-            def step(params, opt_state, tokens, targets):
-                loss, grads = jax.value_and_grad(
-                    lambda p: loss_fn(cfg, p, tokens, targets))(params)
-                updates, opt_state = opt.update(grads, opt_state, params)
-                return optax.apply_updates(params, updates), opt_state, loss
+        # Pallas kernels, numerics-checked on this device (they fall
+        # back to interpret mode off-TPU). Readbacks here are fine: all
+        # timing happened in the probe subprocesses.
+        from ray_tpu.ops import flash_attention, rms_norm_fused
 
-            params, opt_state, loss = step(
-                params, opt_state, tokens, targets)  # compile + warmup
-            jax.block_until_ready(loss)  # completion wait, NOT a readback —
-            # a float(loss) here would flip the tunnel into degraded
-            # dispatch (~11 ms/call) for the whole timed region.
-            times = []
-            for _ in range(repeats):
-                t0 = time.perf_counter()
-                for _ in range(inner):
-                    params, opt_state, loss = step(
-                        params, opt_state, tokens, targets)
-                jax.block_until_ready(loss)
-                times.append((time.perf_counter() - t0) / inner)
-            med, iqr = _median_iqr(times)
-            final_loss = float(loss)  # single readback, after all timing
-            assert np.isfinite(final_loss), f"loss diverged: {final_loss}"
+        q, k, v = (jax.random.normal(
+            jax.random.PRNGKey(3 + i), (2, 4, 512, 128),
+            dtype=jnp.bfloat16) for i in range(3))
+        flash = flash_attention(q, k, v, causal=True)
+        s = jnp.einsum("bhqd,bhkd->bhqk",
+                       q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (128 ** -0.5)
+        mask = (jnp.arange(512)[:, None] >= jnp.arange(512)[None, :])
+        s = jnp.where(mask[None, None], s, -1e30)
+        dense = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+                           v.astype(jnp.float32))
+        flash_err = float(jnp.max(jnp.abs(
+            flash.astype(jnp.float32) - dense)))
+        x = jax.random.normal(jax.random.PRNGKey(9), (256, 1024),
+                              dtype=jnp.bfloat16)
+        w = jnp.ones((1024,), jnp.bfloat16)
+        x32 = x.astype(jnp.float32)
+        ref_rms = (x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6)) * 1.0
+        rms_err = float(jnp.max(jnp.abs(
+            rms_norm_fused(x, w).astype(jnp.float32) - ref_rms)))
 
-            # Pallas kernels, numerics-checked on this device (they fall
-            # back to interpret mode off-TPU; `pallas_native` records which
-            # path actually executed).
-            from ray_tpu.ops import flash_attention, rms_norm_fused
-
-            q, k, v = (jax.random.normal(
-                jax.random.PRNGKey(3 + i), (2, 4, 512, 128),
-                dtype=jnp.bfloat16) for i in range(3))
-            flash = flash_attention(q, k, v, causal=True)
-            s = jnp.einsum("bhqd,bhkd->bhqk",
-                           q.astype(jnp.float32),
-                           k.astype(jnp.float32)) * (128 ** -0.5)
-            mask = (jnp.arange(512)[:, None] >= jnp.arange(512)[None, :])
-            s = jnp.where(mask[None, None], s, -1e30)
-            dense = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
-                               v.astype(jnp.float32))
-            flash_err = float(jnp.max(jnp.abs(
-                flash.astype(jnp.float32) - dense)))
-            x = jax.random.normal(jax.random.PRNGKey(9), (256, 1024),
-                                  dtype=jnp.bfloat16)
-            w = jnp.ones((1024,), jnp.bfloat16)
-            x32 = x.astype(jnp.float32)
-            ref_rms = (x32 * jax.lax.rsqrt(
-                jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6)) * 1.0
-            rms_err = float(jnp.max(jnp.abs(
-                rms_norm_fused(x, w).astype(jnp.float32) - ref_rms)))
-
-        n_params = sum(int(np.prod(x.shape))
-                       for x in jax.tree_util.tree_leaves(params))
-        tokens_per_step = batch * seq
-        # Training FLOPs: 6*N per token (fwd+bwd matmuls) + attention
-        # 12*L*S*D per token (QK^T + PV, fwd+bwd) — the scaling-book
-        # accounting.
-        flops_per_step = (6 * n_params
-                          + 12 * cfg.n_layers * seq * cfg.d_model
-                          ) * tokens_per_step
-        peak = _chip_peak_tflops(device) * 1e12
-        mfu = flops_per_step / (med * peak)
+        base = points[0]
         return {
             "suite": "model_train_step",
             "device": str(getattr(device, "device_kind", device.platform)),
-            "on_accelerator": on_accel,
-            "n_params": n_params,
-            "batch": batch, "seq": seq,
-            "step_time_s": med, "step_time_iqr_s": iqr, "repeats": repeats,
-            "tokens_per_sec": tokens_per_step / med,
-            "model_flops_per_step": flops_per_step,
-            "mfu": round(mfu, 4),
-            "peak_tflops_assumed": peak / 1e12,
+            "on_accelerator": bool(accel),
+            # Headline fields mirror the seq-1024 point for continuity
+            # with earlier rounds' artifacts.
+            "n_params": base["n_params"],
+            "batch": base["batch"], "seq": base["seq"],
+            "step_time_s": base["step_time_s"],
+            "step_time_iqr_s": base["step_time_iqr_s"],
+            "repeats": base["repeats"],
+            "tokens_per_sec": base["tokens_per_sec"],
+            "model_flops_per_step": base["model_flops_per_step"],
+            "mfu": base["mfu"],
+            "peak_tflops_assumed": base["peak_tflops_assumed"],
+            "points": points,
             "flash_attention_max_err": flash_err,
             "rms_norm_fused_max_err": rms_err,
+            "timing": "sync-mode in-process batches with per-batch readback",
         }
     except Exception as e:  # noqa: BLE001 — suite optional until built
         return {"suite": "model_train_step", "skipped": repr(e)}
@@ -362,36 +420,26 @@ from jax.sharding import Mesh
 import ray_tpu
 from ray_tpu.dag import InputNode
 
-@ray_tpu.remote
-def scale(x):
-    return x * 1.001 + 0.5
-
-@ray_tpu.remote
-def merge(a, b):
-    return a + b
-
-with InputNode() as inp:
-    chains = []
-    for _ in range(64):
-        node = inp
-        for _ in range(15):
-            node = scale.bind(node)
-        chains.append(node)
-    while len(chains) > 1:
-        chains = [merge.bind(chains[i], chains[i + 1])
-                  for i in range(0, len(chains), 2)]
-    dag = chains[0]
-
 mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("dag",))
-single = dag.experimental_compile(backend="jax", payload_shape=(1024,))
-sharded = dag.experimental_compile(
-    backend="jax", payload_shape=(1024,), mesh=mesh, mesh_axis="dag")
-x = np.linspace(0.0, 1.0, 1024, dtype=np.float32)
-np.testing.assert_allclose(sharded.execute(x).get(),
-                           single.execute(x).get(), rtol=1e-5)
+N_PHYS_CORES = 1  # the virtual 8-device mesh timeshares this many cores
 
-def timeit(c, n=20):
-    c.execute(x).get()
+
+def build_dag(op, width, depth, merge):
+    with InputNode() as inp:
+        chains = []
+        for _ in range(width):
+            node = inp
+            for _ in range(depth):
+                node = op.bind(node)
+            chains.append(node)
+        while len(chains) > 1:
+            chains = [merge.bind(chains[i], chains[i + 1])
+                      for i in range(0, len(chains), 2)]
+        return chains[0]
+
+
+def timeit(c, x, n=10):
+    jax.block_until_ready(c.execute(x).device_value())
     t0 = time.perf_counter()
     ref = None
     for _ in range(n):
@@ -399,20 +447,78 @@ def timeit(c, n=20):
     jax.block_until_ready(ref.device_value())
     return (time.perf_counter() - t0) / n
 
+
+@ray_tpu.remote
+def scale(x):
+    return x * 1.001 + 0.5
+
+@ray_tpu.remote
+def matsq(x):
+    # Compute-heavy payload-preserving op: one (64,64) matmul per task.
+    return x @ x * 0.01 + x
+
+@ray_tpu.remote
+def merge(a, b):
+    return a + b
+
+
+configs = []
+for name, op, payload, x, depth, rtol in (
+    ("elementwise_1k", scale, (1024,),
+     np.linspace(0.0, 1.0, 1024, dtype=np.float32), 15, 1e-5),
+    ("matmul_heavy", matsq, (64, 64),
+     (np.linspace(0.0, 0.1, 4096, dtype=np.float32).reshape(64, 64)), 15,
+     1e-3),
+):
+    dag = build_dag(op, 64, depth, merge)
+    single = dag.experimental_compile(backend="jax", payload_shape=payload)
+    sharded = dag.experimental_compile(
+        backend="jax", payload_shape=payload, mesh=mesh, mesh_axis="dag")
+    np.testing.assert_allclose(sharded.execute(x).get(),
+                               single.execute(x).get(), rtol=rtol)
+    t1 = timeit(single, x)
+    t8 = timeit(sharded, x)
+    waves = single.num_waves
+    # Crossover model: per-wave compute c on one device vs the sharded
+    # wave cost c/8 + e (exchange). Sharding wins iff the per-wave
+    # exchange latency e < (7/8)*c. On this host the 8 "devices"
+    # timeshare N_PHYS_CORES physical core(s), so compute does NOT
+    # divide by 8 in wall time and a measured win is impossible by
+    # construction; e_star records the budget a real 8-chip ICI hop
+    # has to beat for this exact program.
+    c_wave = t1 / max(waves, 1)
+    e_star = c_wave * (1.0 - 1.0 / 8.0)
+    e_virt = t8 / max(waves, 1) - c_wave * N_PHYS_CORES / 8.0
+    configs.append({
+        "config": name,
+        "payload": list(payload),
+        "num_tasks": single.num_tasks,
+        "num_waves": waves,
+        "export_width": sharded.export_width,
+        "lanes_per_shard": sharded.lanes_per_shard,
+        "exchange_fraction": (sharded.export_width
+                              / max(sharded.lanes_per_shard, 1)),
+        "single_dev_wall_s": t1,
+        "sharded_wall_s": t8,
+        "speedup_x8": t1 / t8,
+        "compute_per_wave_s": c_wave,
+        "exchange_per_wave_virtual_s": e_virt,
+        "ici_crossover_budget_s": e_star,
+        "predicted_speedup_real_8chip": c_wave / (c_wave / 8.0 + 2e-6),
+    })
+
 print(json.dumps({
     "suite": "sharded_dag_1k_tensor",
-    "num_tasks": 64 * 15 + 63,
-    "payload": [1024],
     "num_shards": 8,
-    "export_width": sharded.export_width,
-    "lanes_per_shard": sharded.lanes_per_shard,
-    "exchange_fraction": (sharded.export_width
-                          / max(sharded.lanes_per_shard, 1)),
-    "single_dev_wall_s": timeit(single),
-    "sharded_wall_s": timeit(sharded),
-    "note": "8 virtual CPU devices (no multi-chip hardware); "
-            "exchange_fraction is the compile-time ICI volume vs the "
-            "whole-wave all_gather a replicated exchange would ship",
+    "phys_cores_backing_mesh": N_PHYS_CORES,
+    "configs": configs,
+    "note": "8 virtual CPU devices timesharing 1 physical core: compute "
+            "cannot divide by 8 in wall time, so speedup_x8 < 1 is "
+            "structural to the harness, not the program. The crossover "
+            "model records what real ICI must beat: sharding wins iff "
+            "per-wave exchange latency < ici_crossover_budget_s "
+            "(= 7/8 of measured per-wave compute); "
+            "predicted_speedup_real_8chip assumes a 2 us ICI all_gather.",
 }))
 """
 
@@ -443,14 +549,102 @@ def bench_sharded():
         return {"suite": "sharded_dag_1k_tensor", "skipped": repr(e)}
 
 
-def bench_rl_rollout():
-    """Config #5: PPO rollout collection, CartPole, 64 vectorized envs."""
+def bench_rl_rollout(repeats=4):
+    """Config #5: PPO rollout collection, CartPole, 64 vectorized envs.
+    Marginal-timed via fresh-process probes (honest-timing note at
+    _run_probe)."""
     try:
-        from ray_tpu.rl.bench import rollout_throughput
-
-        return rollout_throughput(num_envs=64)
+        num_envs, rollout_len = 64, 512
+        margs = _marginal_times("rl", 25, 275, repeats)
+        steps = num_envs * rollout_len
+        rate_med, rate_iqr = _median_iqr(
+            [steps / m for m in margs if m > 0])
+        return {
+            "suite": "rl_rollout",
+            "env_steps_per_sec": rate_med,
+            "env_steps_per_sec_iqr": rate_iqr,
+            "num_envs": num_envs,
+            "rollout_len": rollout_len,
+            "wall_s_per_rollout": steps / rate_med,
+            "repeats": repeats,
+            "timing": "two-point marginal over fresh-process probes",
+        }
     except Exception as e:  # noqa: BLE001 — suite optional until built
         return {"suite": "rl_rollout", "skipped": repr(e)}
+
+
+def _probe_main(args):
+    """One fresh-process probe measurement (honest-timing note at
+    _run_probe): wall-clock from first dispatch to a SINGLE final
+    readback, over `n` data-dependent iterations."""
+    import numpy as np
+
+    n = args.probe_n
+
+    if args.probe == "chain":
+        compiled = _build_chain_dag()
+        t0 = time.perf_counter()
+        ref = compiled.execute(0.5)
+        for _ in range(n - 1):
+            ref = compiled.execute(ref.device_value())
+        final = float(np.asarray(ref.get()))
+        wall = time.perf_counter() - t0
+        assert final == 0.5, final
+    elif args.probe == "chain_sync":
+        compiled = _build_chain_dag()
+        # First readback switches the tunnel to synchronous dispatch;
+        # every timed get below is a true end-to-end round trip.
+        assert float(np.asarray(compiled.execute(0.5).get())) == 0.5
+        times = _time_executions(compiled, n, 0.0)
+        times.sort()
+        print(json.dumps({
+            "p50_s": times[len(times) // 2],
+            "p99_s": times[min(len(times) - 1, int(len(times) * 0.99))],
+        }))
+        return
+    elif args.probe == "fanout":
+        width = 10_000
+        compiled = _build_fanout_dag(width)
+        assert compiled.num_tasks == 13334, compiled.num_tasks
+        scale = 1.0 / width
+        t0 = time.perf_counter()
+        ref = compiled.execute(1.0)
+        for _ in range(n - 1):
+            # Rescale on device so the fan-in sum stays at `width`
+            # instead of overflowing; keeps every exec data-dependent.
+            ref = compiled.execute(ref.device_value() * scale)
+        final = float(np.asarray(ref.get()))
+        wall = time.perf_counter() - t0
+        assert abs(final - width) < 1.0, final
+    elif args.probe == "rl":
+        from ray_tpu.rl.env import CartPole
+        from ray_tpu.rl.env_runner import EnvRunner
+        from ray_tpu.rl.ppo import PPOLearner
+
+        import jax
+        import jax.numpy as jnp
+
+        env = CartPole()
+        learner = PPOLearner(env)
+        runner = EnvRunner(env, num_envs=64, rollout_len=512)
+        params = learner.get_weights()
+        t0 = time.perf_counter()
+        ro = None
+        for _ in range(n):
+            ro = runner.sample(params)
+            # Thread the rollout back into the next sample's params (a
+            # zero-valued perturbation): without the data dependence the
+            # tunnel lazily skips rollouts whose buffers are never read,
+            # and the marginal collapses to host dispatch time.
+            tie = jnp.sum(ro.rewards) * 0.0
+            params = jax.tree_util.tree_map(
+                lambda p: p + tie.astype(p.dtype), params)
+        final = float(np.asarray(ro.rewards).sum())
+        wall = time.perf_counter() - t0
+        assert np.isfinite(final), final
+    else:
+        raise SystemExit(f"unknown probe {args.probe}")
+    print(json.dumps({"wall_s": wall, "n": n}))
 
 
 def main():
@@ -461,11 +655,18 @@ def main():
         "chain", "fanout", "actor", "data", "rl", "model", "sharded"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
+    parser.add_argument("--probe", default=None,
+                        help="internal: one fresh-process measurement")
+    parser.add_argument("--probe-n", type=int, default=10)
     args = parser.parse_args()
 
+    if args.probe:
+        _probe_main(args)
+        return
+
     suites = {
-        "chain": lambda: bench_chain(n_iters=args.iters),
-        "fanout": lambda: bench_fanout(n_iters=args.iters),
+        "chain": bench_chain,
+        "fanout": bench_fanout,
         "actor": bench_actor_pipeline,
         "data": bench_data_map_batches,
         "rl": bench_rl_rollout,

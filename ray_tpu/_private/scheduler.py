@@ -42,6 +42,7 @@ class TaskSpec:
     max_retries: int = 0
     retry_exceptions: bool = False
     scheduling_strategy: Any = None
+    runtime_env: Any = None
     # Filled by the scheduler:
     attempt: int = 0
 
@@ -540,7 +541,7 @@ class LocalScheduler:
         from ray_tpu._private.worker_pool import maybe_stage
 
         ctx = global_worker().serialization_context
-        w = self._worker_pool.lease()
+        w = self._worker_pool.lease(runtime_env=spec.runtime_env)
         staged: list = []
         ret_keys = [self._ret_key(oid, spec.attempt)
                     for oid in spec.return_ids]
@@ -572,9 +573,12 @@ class LocalScheduler:
             with self._lock:
                 self._proc_running[spec.task_id] = w
             try:
+                env_fields = (dict(spec.runtime_env)
+                              if spec.runtime_env is not None else None)
                 w.request(
                     ("task", digest, fn_bytes, payload, ret_keys,
-                     spec.num_returns, spec.task_id.binary(), spec.name),
+                     spec.num_returns, spec.task_id.binary(), spec.name,
+                     env_fields),
                     cancel_event=cancelled_event)
             finally:
                 with self._lock:

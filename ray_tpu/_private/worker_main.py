@@ -248,7 +248,7 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 _reply(("ok", os.getpid()))
             elif kind == "task":
                 (_, digest, fn_bytes, payload, return_keys, num_returns,
-                 task_id_bin, name) = msg
+                 task_id_bin, name, env_fields) = msg
                 fn = fn_cache.get(digest)
                 if fn is None:
                     fn = cloudpickle.loads(_fetch_blob(store, fn_bytes))
@@ -257,7 +257,17 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                                              _fetch_blob(store, payload))
                 _set_task_ctx(task_id_bin, name)
                 try:
-                    result = fn(*args, **kwargs)
+                    if env_fields:
+                        from ray_tpu.runtime_env import RuntimeEnv
+
+                        renv = RuntimeEnv(**{
+                            k: v for k, v in env_fields.items()
+                            if k in ("env_vars", "working_dir",
+                                     "py_modules", "pip")})
+                        with renv.stage().applied():
+                            result = fn(*args, **kwargs)
+                    else:
+                        result = fn(*args, **kwargs)
                 finally:
                     _set_task_ctx(None, None)
                 _store_outputs(store, ctx, return_keys, result, num_returns)

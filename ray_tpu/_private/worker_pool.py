@@ -63,9 +63,15 @@ class WorkerProcess:
 
     def __init__(self, store, max_msg: int = 4 << 20,
                  env: Optional[Dict[str, str]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 python_exe: Optional[str] = None,
+                 env_key: Optional[str] = None):
         from ray_tpu._native.store import NativeMutableChannel
 
+        # Runtime-env binding: a pip env's venv interpreter + its content
+        # key (None = the driver's interpreter / default sub-pool).
+        self.python_exe = python_exe or sys.executable
+        self.env_key = env_key
         with WorkerProcess._id_lock:
             WorkerProcess._id_counter[0] += 1
             self.worker_id = WorkerProcess._id_counter[0]
@@ -90,7 +96,7 @@ class WorkerProcess:
         self._api_rep = NativeMutableChannel(
             store, self._api_rep_id, max_size=max_msg, num_readers=1)
         cmd = [
-            sys.executable, "-m", "ray_tpu._private.worker_main",
+            self.python_exe, "-m", "ray_tpu._private.worker_main",
             "--store", store.name,
             "--req-id", str(self._req_id),
             "--rep-id", str(self._rep_id),
@@ -229,6 +235,9 @@ class WorkerPool:
         self._log_dir = log_dir
         self._lock = threading.Lock()
         self._idle: "queue.Queue[WorkerProcess]" = queue.Queue()
+        # Pip runtime envs get their own idle queues: those workers run a
+        # different interpreter and must never serve default-env tasks.
+        self._env_idle: Dict[str, "queue.Queue[WorkerProcess]"] = {}
         self._all: List[WorkerProcess] = []
         self._shutdown = False
         self._spawning = 0  # growth slots reserved but not yet spawned
@@ -241,7 +250,9 @@ class WorkerPool:
         # pool up front serializes ~0.4s of interpreter startup per worker
         # on the CPU that init()'s caller is about to use.
 
-    def _try_spawn(self, limit: int) -> Optional[WorkerProcess]:
+    def _try_spawn(self, limit: int, python_exe: Optional[str] = None,
+                   env_key: Optional[str] = None
+                   ) -> Optional[WorkerProcess]:
         """Reserve a slot under `limit` and spawn outside the lock."""
         with self._lock:
             if (self._shutdown
@@ -250,7 +261,8 @@ class WorkerPool:
             self._spawning += 1
         try:
             fresh = WorkerProcess(self._store, max_msg=self._max_msg,
-                                  log_dir=self._log_dir)
+                                  log_dir=self._log_dir,
+                                  python_exe=python_exe, env_key=env_key)
         except Exception:  # noqa: BLE001 — e.g. shm store full
             fresh = None
         with self._lock:
@@ -262,9 +274,13 @@ class WorkerPool:
             fresh.shutdown(timeout=0.1)
         return None
 
-    def lease(self, timeout: float = 60.0) -> WorkerProcess:
+    def lease(self, timeout: float = 60.0,
+              runtime_env=None) -> WorkerProcess:
         import time as _time
 
+        env_key = runtime_env.env_key() if runtime_env is not None else None
+        if env_key is not None:
+            return self._lease_env(runtime_env, env_key, timeout)
         deadline = _time.monotonic() + timeout
         while True:
             if self._shutdown:
@@ -306,13 +322,76 @@ class WorkerPool:
             # Crashed while idle: replace and retry.
             self._replace(w)
 
+    def _lease_env(self, runtime_env, env_key: str,
+                   timeout: float) -> WorkerProcess:
+        """Lease a worker bound to a pip runtime env. The venv build is
+        lazy — the first lease pays it (reference role: runtime-env agent
+        building before the lease is granted)."""
+        import time as _time
+
+        with self._lock:
+            q = self._env_idle.setdefault(env_key, queue.Queue())
+        deadline = _time.monotonic() + timeout
+        python_exe = runtime_env.python_executable()  # builds on first use
+        while True:
+            if self._shutdown:
+                raise WorkerPoolExhaustedError("worker pool is shut down")
+            try:
+                w = q.get_nowait()
+            except queue.Empty:
+                fresh = self._try_spawn(self._max_workers,
+                                        python_exe=python_exe,
+                                        env_key=env_key)
+                if fresh is None:
+                    # Pool at cap but holding idle DEFAULT workers: evict
+                    # one to make room — env demand must not starve
+                    # behind reclaimable default capacity.
+                    try:
+                        idle_default = self._idle.get_nowait()
+                    except queue.Empty:
+                        pass
+                    else:
+                        self._remove_dead(idle_default)
+                        fresh = self._try_spawn(self._max_workers,
+                                                python_exe=python_exe,
+                                                env_key=env_key)
+                if fresh is not None:
+                    return fresh
+                try:
+                    w = q.get(timeout=0.5)
+                except queue.Empty:
+                    if _time.monotonic() >= deadline:
+                        raise WorkerPoolExhaustedError(
+                            f"no idle worker for runtime env {env_key} "
+                            f"within {timeout:.0f}s") from None
+                    continue
+            if w.alive():
+                return w
+            self._remove_dead(w)
+
+    def _remove_dead(self, dead: WorkerProcess):
+        with self._lock:
+            try:
+                self._all.remove(dead)
+            except ValueError:
+                pass
+        dead.shutdown(timeout=0.1)
+
     def release(self, w: WorkerProcess):
         if self._shutdown:
             return
-        if w.alive():
-            self._idle.put(w)
+        if not w.alive():
+            if w.env_key is not None:
+                self._remove_dead(w)  # env workers respawn on demand
+            else:
+                self._replace(w)
+            return
+        if w.env_key is not None:
+            with self._lock:
+                q = self._env_idle.setdefault(w.env_key, queue.Queue())
+            q.put(w)
         else:
-            self._replace(w)
+            self._idle.put(w)
 
     def _replace(self, dead: WorkerProcess):
         with self._lock:

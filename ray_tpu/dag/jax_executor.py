@@ -137,16 +137,65 @@ class CompiledJaxDAG:
     def teardown(self):
         """API parity with the actor-loop backend; nothing to stop here."""
 
-    def visualize_schedule(self) -> str:
+    def visualize_schedule(self, max_lanes: int = 8) -> str:
+        """Render the compiled schedule: per-wave (and per-shard) lane
+        tables with output slots, exported lanes marked `*` and each
+        wave's cross-shard exchange spelled out (reference role:
+        CompiledDAG schedule visualization, SURVEY.md §2.3)."""
         shards = (f", sharded ×{self.num_shards}" if self.num_shards > 1
                   else "")
-        return (
+        header = (
             f"CompiledJaxDAG: {self.num_tasks} tasks, "
             f"{self.num_waves} waves × width {self.wave_width}{shards}, "
             f"{'dynamic frontier' if self.dynamic else 'static levels'}, "
             f"payload {self.payload_shape} {jnp.dtype(self.dtype).name}, "
             f"ops {self.op_names}"
         )
+        viz = getattr(self, "_viz", None)
+        if not viz:
+            return header
+        lines = [header]
+
+        def lane_str(entries, exported_flags=None):
+            cells = []
+            for i, e in enumerate(entries[:max_lanes]):
+                ci, name, slot = e[0], e[1], e[2]
+                star = "*" if (len(e) > 3 and e[3]) else ""
+                cells.append(f"[{ci}]{name}->s{slot}{star}")
+            if len(entries) > max_lanes:
+                cells.append(f"… +{len(entries) - max_lanes} lanes")
+            return "  ".join(cells)
+
+        if viz["mode"] == "static":
+            for wi, wave in enumerate(viz["waves"]):
+                lines.append(f"wave {wi}: {lane_str(wave)}")
+        elif viz["mode"] == "sharded_static":
+            for wi, by_shard in enumerate(viz["waves"]):
+                lines.append(f"wave {wi}:")
+                exports = []
+                for sh in range(viz["n_sh"]):
+                    entries = by_shard.get(sh, [])
+                    if entries:
+                        lines.append(f"  shard {sh}: {lane_str(entries)}")
+                    for ci, name, slot, exp in entries:
+                        if exp:
+                            exports.append(f"shard{sh}:[{ci}]->s{slot}")
+                if exports:
+                    lines.append(
+                        "  exchange (all_gather): " + ", ".join(exports))
+                else:
+                    lines.append("  exchange: none (no collective)")
+        elif viz["mode"] == "dynamic":
+            lines.append(
+                f"dynamic frontier over {len(viz['tasks'])} compiled "
+                f"tasks, {viz['n_edges']} edges"
+                + (f", frontier width {viz['frontier_width']}/shard"
+                   if viz.get("frontier_width") else ""))
+            for ci, name, slot in viz["tasks"][:max_lanes]:
+                lines.append(f"  [{ci}]{name}->s{slot}")
+            if len(viz["tasks"]) > max_lanes:
+                lines.append(f"  … +{len(viz['tasks']) - max_lanes} tasks")
+        return "\n".join(lines)
 
 
 def compile_jax_dag(
@@ -488,6 +537,9 @@ def compile_jax_dag(
         for wi, w in enumerate(waves):
             sched[wi, : len(w)] = w
 
+        viz_names = [f[4] for f in fused]
+        viz_out_slots = [int(s) for s in out_slots]
+
         if mesh is None:
             def program(inputs):
                 sched_c = jnp.asarray(sched)   # trace-time literal
@@ -502,6 +554,12 @@ def compile_jax_dag(
                         lambda w, o: _run_tasks(o, sched_c[w]), obj)
                 out = obj[jnp.asarray(leaf_slots)]
                 return out if multi_output else out[0]
+
+            program.viz = {
+                "mode": "static",
+                "waves": [[(ci, viz_names[ci], viz_out_slots[ci])
+                           for ci in w] for w in waves],
+            }
 
         else:
             # ---- mesh-sharded static waves ----------------------------------
@@ -631,6 +689,20 @@ def compile_jax_dag(
 
             program.export_width = X_max
             program.lanes_per_shard = Wn
+            exported_set = {ci for sh in range(n_sh)
+                            for wi in range(num_waves)
+                            for ci in exports[sh][wi]}
+            program.viz = {
+                "mode": "sharded_static",
+                "n_sh": n_sh,
+                "waves": [
+                    {sh: [(int(ci), viz_names[int(ci)],
+                           viz_out_slots[int(ci)], int(ci) in exported_set)
+                          for ci in sched_sh[sh, wi] if ci >= 0]
+                     for sh in range(n_sh)}
+                    for wi in range(num_waves)
+                ],
+            }
 
     else:
         # ---- dynamic frontier (lax.while_loop) ------------------------------
@@ -777,4 +849,12 @@ def compile_jax_dag(
     # actually shipped over ICI per wave (X_max == 0 ⇒ no collective).
     dag.export_width = getattr(program, "export_width", None)
     dag.lanes_per_shard = getattr(program, "lanes_per_shard", None)
+    dag._viz = getattr(program, "viz", None)
+    if dag._viz is None and dynamic:
+        dag._viz = {
+            "mode": "dynamic",
+            "tasks": [(ci, f[4], int(f[2])) for ci, f in enumerate(fused)],
+            "n_edges": len(edges_src),
+            "frontier_width": getattr(program, "export_width", None),
+        }
     return dag

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Optional
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.scheduler import TaskSpec
+from ray_tpu.runtime_env import coerce_runtime_env as _coerce_env
 
 _OPTION_KEYS = frozenset({
     "num_returns", "num_cpus", "num_tpus", "num_gpus", "resources",
@@ -79,6 +80,7 @@ class RemoteFunction:
             max_retries=max_retries,
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=_coerce_env(opts.get("runtime_env")),
         )
         refs = worker.submit_task(spec)
         if num_returns == 0:

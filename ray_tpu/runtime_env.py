@@ -1,41 +1,153 @@
 """Runtime environments (reference role: ray/runtime_env + the per-node
 runtime-env agent [unverified]).
 
-Scope honest to this runtime: workers are in-process, so ``env_vars`` apply
-around task/actor execution (saved+restored), ``working_dir`` is copied to a
-session-scoped dir and prepended to sys.path, and ``py_modules`` paths are
-importable. Process-isolated envs (pip/conda/container) are declared but
-rejected loudly rather than silently ignored.
+Supported fields:
+
+- ``env_vars`` — applied around task/actor execution in the worker
+  (saved + restored).
+- ``working_dir`` — copied to a session-scoped dir and prepended to
+  ``sys.path``.
+- ``py_modules`` — extra importable paths.
+- ``pip`` — a list of requirement specs (names, local wheel/sdist paths).
+  Builds a content-addressed virtualenv per unique requirement set and
+  runs the task's worker process under that venv's interpreter. The venv
+  inherits the driver environment's site-packages through a ``.pth``
+  file appended AFTER the venv's own site dir, so pip-installed packages
+  override inherited ones while jax/numpy stay importable without a
+  reinstall. Builds are lazy (first lease that needs the env) and cached
+  across sessions under ``~/.cache/ray_tpu/runtime_envs`` (override:
+  ``RAY_TPU_RUNTIME_ENV_CACHE``).
+
+``conda``/``container``/``uv`` envs are declared but rejected loudly
+rather than silently ignored.
 """
 
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import os
 import shutil
+import subprocess
 import sys
+import sysconfig
 import tempfile
 import threading
 from typing import Any, Dict, List, Optional
 
-_UNSUPPORTED = ("pip", "conda", "container", "uv")
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+_UNSUPPORTED = ("conda", "container", "uv")
 _apply_lock = threading.Lock()
+
+
+def _cache_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_RUNTIME_ENV_CACHE",
+        os.path.expanduser("~/.cache/ray_tpu/runtime_envs"))
+
+
+def pip_env_key(pip: List[str]) -> str:
+    """Content address of a pip requirement set (+ interpreter version)."""
+    h = hashlib.sha256()
+    h.update(sys.version.split()[0].encode())
+    for spec in sorted(pip):
+        # Local paths hash by content so a rebuilt wheel busts the cache.
+        if os.path.exists(spec):
+            with open(spec, "rb") as f:
+                h.update(f.read())
+        else:
+            h.update(spec.encode())
+    return h.hexdigest()[:16]
+
+
+def ensure_pip_env(pip: List[str]) -> str:
+    """Build (or reuse) the venv for this requirement set; returns its
+    python executable. Concurrent builders coordinate via flock."""
+    import fcntl
+
+    key = pip_env_key(pip)
+    root = os.path.join(_cache_root(), key)
+    python = os.path.join(root, "bin", "python")
+    ready = os.path.join(root, ".ready")
+    if os.path.exists(ready):
+        return python
+    os.makedirs(_cache_root(), exist_ok=True)
+    lock_path = os.path.join(_cache_root(), f"{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(ready):  # lost the build race — fine
+                return python
+            if os.path.exists(root):
+                shutil.rmtree(root, ignore_errors=True)
+            subprocess.run(
+                [sys.executable, "-m", "venv", root],
+                check=True, capture_output=True, timeout=300)
+            # Inherit the driver env's packages, venv's own dir first.
+            site_dir = subprocess.run(
+                [python, "-c",
+                 "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+                check=True, capture_output=True, text=True,
+                timeout=60).stdout.strip()
+            parent_site = sysconfig.get_paths()["purelib"]
+            with open(os.path.join(site_dir, "_parent_site.pth"), "w") as f:
+                f.write(parent_site + "\n")
+            subprocess.run(
+                [python, "-m", "pip", "install", "--quiet", *pip],
+                check=True, capture_output=True, timeout=600)
+            with open(ready, "w") as f:
+                f.write("\n".join(sorted(pip)))
+            return python
+        except subprocess.CalledProcessError as e:
+            shutil.rmtree(root, ignore_errors=True)
+            tail = e.stderr or ""
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            raise RuntimeEnvSetupError(
+                f"pip runtime env build failed for {pip}: "
+                f"{tail[-2000:]}") from e
+        except Exception as e:
+            shutil.rmtree(root, ignore_errors=True)
+            raise RuntimeEnvSetupError(
+                f"pip runtime env build failed for {pip}: {e!r}") from e
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
-                 py_modules: Optional[List[str]] = None, **kwargs):
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[List[str]] = None, **kwargs):
         bad = [k for k in kwargs if k in _UNSUPPORTED]
         if bad:
             raise ValueError(
-                f"runtime_env features {bad} need process-isolated workers; "
-                f"this runtime executes in-process (supported: env_vars, "
-                f"working_dir, py_modules)")
+                f"runtime_env features {bad} are not supported by this "
+                f"runtime (supported: env_vars, working_dir, py_modules, "
+                f"pip)")
         super().__init__(
             env_vars=env_vars or {}, working_dir=working_dir,
-            py_modules=py_modules or [], **kwargs)
+            py_modules=py_modules or [], pip=list(pip or []), **kwargs)
         self._staged_dir: Optional[str] = None
+        self._env_key: Optional[str] = None
+
+    def env_key(self) -> Optional[str]:
+        """Worker-binding key: tasks sharing it may share a worker
+        process. Only pip envs change the interpreter; the other fields
+        apply per-execution inside any worker."""
+        if not self.get("pip"):
+            return None
+        if self._env_key is None:  # hashing local wheels reads them; cache
+            self._env_key = pip_env_key(self["pip"])
+        return self._env_key
+
+    def python_executable(self) -> Optional[str]:
+        """Build (lazily) and return this env's interpreter, or None when
+        the default interpreter serves."""
+        if not self.get("pip"):
+            return None
+        return ensure_pip_env(self["pip"])
 
     def stage(self) -> "RuntimeEnv":
         """Copy working_dir into a session dir (content-addressed caching is
@@ -76,3 +188,12 @@ class RuntimeEnv(dict):
                         sys.path.remove(p)
                     except ValueError:
                         pass
+
+
+def coerce_runtime_env(env: Any) -> Optional[RuntimeEnv]:
+    """Accept RuntimeEnv | plain dict | None from task options."""
+    if env is None:
+        return None
+    if isinstance(env, RuntimeEnv):
+        return env
+    return RuntimeEnv(**env)

@@ -516,3 +516,32 @@ def test_actor_dag_transport_hints():
     with _pytest.raises(ValueError, match="process-backed"):
         dag2.experimental_compile(backend="actor")
     ray_tpu.shutdown()
+
+
+def test_visualize_schedule_names_exports(ray_start_regular):
+    """A sharded 3-wave DAG's rendering lists per-shard lanes and names
+    the slots each wave exports through the all_gather exchange."""
+    with InputNode() as inp:
+        layer = [inc.bind(inp) for _ in range(16)]
+        while len(layer) > 1:
+            layer = [add.bind(layer[i], layer[i + 1])
+                     for i in range(0, len(layer), 2)]
+        dag = layer[0]
+    sharded = dag.experimental_compile(
+        backend="jax", payload_shape=(4,), fuse=False,
+        mesh=_dag_mesh(), mesh_axis="dag")
+    text = sharded.visualize_schedule()
+    assert "wave 0" in text and "wave 2" in text
+    assert "shard 0" in text
+    # The fan-in waves must export producer slots across shards.
+    assert "exchange (all_gather)" in text
+    assert "->s" in text
+    # Exported lanes are marked and name their slot.
+    import re
+    exports = re.findall(r"shard\d+:\[\d+\]->s(\d+)", text)
+    assert exports, text
+    # Single-device rendering shows per-wave lane tables too.
+    single = dag.experimental_compile(
+        backend="jax", payload_shape=(4,), fuse=False)
+    stext = single.visualize_schedule()
+    assert "wave 0:" in stext and "inc->s" in stext

@@ -126,7 +126,7 @@ def test_runtime_env_env_vars_and_unsupported():
         assert os.environ["RAY_TPU_TEST_VAR"] == "on"
     assert os.environ.get("RAY_TPU_TEST_VAR") is None
     with pytest.raises(ValueError):
-        RuntimeEnv(pip=["requests"])
+        RuntimeEnv(conda={"dependencies": ["requests"]})
 
 
 def test_job_submission_lifecycle(tmp_path):
