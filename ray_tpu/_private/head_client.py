@@ -35,6 +35,25 @@ from ray_tpu._private.transport import (
 _PULL_CHUNK = 4 * 1024 * 1024  # object pulls ride 4 MiB frames
 
 
+class Subscription:
+    """Handle to one topic subscription: .get() pulls the next payload,
+    .close() unsubscribes."""
+
+    def __init__(self, client, topic: str):
+        import queue as _queue
+
+        self._client = client
+        self.topic = topic
+        self._queue: "_queue.Queue" = _queue.Queue()
+        self._handler = None  # set by subscribe()
+
+    def get(self, timeout: Optional[float] = None):
+        return self._queue.get(timeout=timeout)
+
+    def close(self):
+        self._client.unsubscribe(self.topic, self._handler)
+
+
 def parse_address(address: str) -> Tuple[str, int]:
     host, _, port = address.rpartition(":")
     return host or "127.0.0.1", int(port)
@@ -52,6 +71,8 @@ class HeadClient:
         self.status_fn: Optional[Callable[[], dict]] = None
         self._lock = threading.Lock()
         self._hb_lock = threading.Lock()
+        self._subs_lock = threading.Lock()
+        self._subs: Dict[str, list] = {}  # topic -> delivery callbacks
         self._reconnect_lock = threading.Lock()
         self._stop = threading.Event()
         self._req = self._dial("request")
@@ -194,6 +215,10 @@ class HeadClient:
                 if not self._reconnect_event():
                     return
                 continue
+            if msg[0] == "evt":
+                topic, payload = msg[1], msg[2]
+                self._pool.submit(self._deliver_evt, topic, payload)
+                continue
             if msg[0] != "req":
                 continue
             rid, event = msg[1], msg[2:]
@@ -291,6 +316,51 @@ class HeadClient:
             return self._serialized_bytes(oid_bin)[offset:offset + length]
         raise ValueError(f"unknown event {kind!r}")
 
+    # -------------------------------------------------------------- pubsub
+    def subscribe(self, topic: str, callback=None):
+        """Subscribe this client to a topic. Returns a Subscription whose
+        .get(timeout) yields payloads (when no callback is given).
+        Re-asserted on every heartbeat so a head restart keeps it."""
+        sub = Subscription(self, topic)
+        handler = callback if callback is not None else sub._queue.put
+        sub._handler = handler
+        with self._subs_lock:
+            self._subs.setdefault(topic, []).append(handler)
+        self._request(("subscribe", topic))
+        return sub
+
+    def unsubscribe(self, topic: str, handler=None):
+        """Drop one handler (or all, when handler is None); the head-side
+        subscription ends only when the topic has no handlers left."""
+        with self._subs_lock:
+            if handler is None:
+                self._subs.pop(topic, None)
+            else:
+                handlers = self._subs.get(topic, [])
+                if handler in handlers:
+                    handlers.remove(handler)
+                if handlers:
+                    return  # siblings still listening — keep head sub
+                self._subs.pop(topic, None)
+        try:
+            self._request(("unsubscribe", topic))
+        except Exception:  # noqa: BLE001 — head may be down; local is off
+            pass
+
+    def publish(self, topic: str, payload) -> int:
+        """Publish to all subscribers cluster-wide; returns the number of
+        clients the head pushed to."""
+        return self._request(("publish", topic, payload))
+
+    def _deliver_evt(self, topic: str, payload):
+        with self._subs_lock:
+            handlers = list(self._subs.get(topic, ()))
+        for h in handlers:
+            try:
+                h(payload)
+            except Exception:  # noqa: BLE001 — subscriber callback bug
+                pass
+
     def _heartbeat_loop(self):
         while not self._stop.wait(0.5):
             status = None
@@ -299,6 +369,11 @@ class HeadClient:
                     status = self.status_fn()
                 except Exception:  # noqa: BLE001
                     status = None
+            with self._subs_lock:
+                topics = list(self._subs)
+            if topics:
+                status = dict(status or {})
+                status["_subs"] = topics
             msg = ("heartbeat", status) if status else ("heartbeat",)
             try:
                 with self._hb_lock:

@@ -100,6 +100,17 @@ class _EventChannel:
                        "message": why}
             slot[0].set()
 
+    def notify(self, event: tuple) -> bool:
+        """One-way push (pub/sub delivery): no request id, no reply."""
+        if not self.alive:
+            return False
+        try:
+            self.conn.send(("evt",) + event)
+            return True
+        except Exception as exc:  # noqa: BLE001
+            self.fail_all(str(exc))
+            return False
+
     def call(self, event: tuple, timeout: Optional[float] = None):
         if not self.alive:
             return ("err", {"type": "ConnectionError", "module": "builtins",
@@ -135,6 +146,7 @@ class _Client:
         self.node_id: Optional[str] = None
         self.resources: Dict[str, float] = {}
         self.status: Dict[str, Any] = {}  # last heartbeat load report
+        self.subs: set = set()  # pub/sub topics (re-asserted by heartbeat)
 
 
 class _StateLog:
@@ -314,7 +326,24 @@ class HeadService:
                 if len(msg) > 1 and isinstance(msg[1], dict):
                     with self._lock:
                         c.status = msg[1]
+                        # Subscriptions piggyback on heartbeats so they
+                        # survive a head restart (the state log does not
+                        # persist them; the owner re-asserts).
+                        subs = msg[1].get("_subs")
+                        if subs is not None:
+                            c.subs = set(subs)
                 return ("ok", None)
+            if kind == "subscribe":
+                with self._lock:
+                    c.subs.add(msg[1])
+                return ("ok", None)
+            if kind == "unsubscribe":
+                with self._lock:
+                    c.subs.discard(msg[1])
+                return ("ok", None)
+            if kind == "publish":
+                _, topic, payload = msg
+                return ("ok", self._publish(topic, payload))
             if kind == "kv_put":
                 _, key, value, overwrite = msg
                 with self._lock:
@@ -408,6 +437,9 @@ class HeadService:
                     c.resources = dict(resources)
                 self._persist("node_register", client_id, node_id,
                               dict(resources))
+                self._publish("ray_tpu:node_events", {
+                    "event": "node_added", "client_id": client_id,
+                    "node_id": node_id, "resources": dict(resources)})
                 return ("ok", None)
             if kind == "node_list":
                 with self._lock:
@@ -450,6 +482,16 @@ class HeadService:
         except Exception as exc:  # noqa: BLE001 — dispatch boundary
             return ("err", exc_to_wire(exc))
 
+    def _publish(self, topic: str, payload) -> int:
+        """Fan a message out to every live subscriber of `topic`
+        (general pub/sub — the GCS publisher role). Delivery is
+        at-most-once over the event channels; returns the count pushed."""
+        with self._lock:
+            targets = [c.events for c in self._clients.values()
+                       if c.alive and topic in c.subs
+                       and c.events is not None and c.events.alive]
+        return sum(1 for ev in targets if ev.notify((topic, payload)))
+
     def _object_owner(self, oid_bin: bytes) -> Optional[str]:
         with self._lock:
             owner = self._objects.get(oid_bin)
@@ -476,10 +518,12 @@ class HeadService:
         timeout_s = _client_timeout_s()
         while not self._stop.wait(_HEARTBEAT_PERIOD_S):
             now = time.monotonic()
+            newly_dead = []
             with self._lock:
                 for c in self._clients.values():
                     if c.alive and now - c.last_seen > timeout_s:
                         c.alive = False  # failure detection
+                        newly_dead.append((c.client_id, c.node_id))
                 # GC directory entries owned by dead clients.
                 dead = {cid for cid, c in self._clients.items()
                         if not c.alive}
@@ -507,6 +551,10 @@ class HeadService:
                 self._persist("actor_deregister", ns, name)
             for oid in dropped_objects:
                 self._persist("object_forget", oid)
+            for cid, node_id in newly_dead:
+                self._publish("ray_tpu:node_events", {
+                    "event": "node_dead", "client_id": cid,
+                    "node_id": node_id})
 
     def shutdown(self):
         self._stop.set()

@@ -23,6 +23,7 @@ from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.task_events import TaskEventBuffer
 from ray_tpu.exceptions import (
     RayTaskError,
+    RuntimeEnvSetupError,
     TaskCancelledError,
 )
 
@@ -350,7 +351,18 @@ class LocalScheduler:
                 worker_mod._task_context.current_task_id = spec.task_id
                 worker_mod._task_context.task_name = spec.name
                 try:
-                    result = spec.function(*args, **kwargs)
+                    renv = spec.runtime_env
+                    if renv is not None and renv.get("pip"):
+                        # Thread-plane workers share the driver
+                        # interpreter; a pip env cannot apply here.
+                        raise RuntimeEnvSetupError(
+                            "pip runtime envs need process workers "
+                            "(worker_mode='process', the default)")
+                    if renv is not None:
+                        with renv.stage().applied():
+                            result = spec.function(*args, **kwargs)
+                    else:
+                        result = spec.function(*args, **kwargs)
                 finally:
                     worker_mod._task_context.current_task_id = None
                     worker_mod._task_context.task_name = None
@@ -655,6 +667,7 @@ class LocalScheduler:
                 resources=spec.resources, max_retries=spec.max_retries,
                 retry_exceptions=spec.retry_exceptions,
                 scheduling_strategy=spec.scheduling_strategy,
+                runtime_env=spec.runtime_env,
                 attempt=spec.attempt + 1,
             )
         if isinstance(exc, (TaskCancelledError, RayTaskError,

@@ -258,13 +258,8 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 _set_task_ctx(task_id_bin, name)
                 try:
                     if env_fields:
-                        from ray_tpu.runtime_env import RuntimeEnv
-
-                        renv = RuntimeEnv(**{
-                            k: v for k, v in env_fields.items()
-                            if k in ("env_vars", "working_dir",
-                                     "py_modules", "pip")})
-                        with renv.stage().applied():
+                        renv = _cached_runtime_env(env_fields)
+                        with renv.applied():
                             result = fn(*args, **kwargs)
                     else:
                         result = fn(*args, **kwargs)
@@ -398,6 +393,27 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 err = RayTaskError(str(name), traceback.format_exc(),
                                    cause=None)
                 _reply(("err", pickle.dumps(err)))
+
+
+_renv_cache = {}
+
+
+def _cached_runtime_env(env_fields):
+    """One staged RuntimeEnv per distinct env per worker process: staging
+    copies working_dir into a tempdir, which must not repeat (or leak)
+    per task execution."""
+    import pickle as _pickle
+
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    fields = {k: v for k, v in env_fields.items()
+              if k in ("env_vars", "working_dir", "py_modules", "pip")}
+    key = _pickle.dumps(sorted(fields.items()))
+    renv = _renv_cache.get(key)
+    if renv is None:
+        renv = RuntimeEnv(**fields).stage()
+        _renv_cache[key] = renv
+    return renv
 
 
 def main(argv=None) -> int:

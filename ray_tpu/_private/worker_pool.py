@@ -309,6 +309,14 @@ class WorkerPool:
                 # leases must not deadlock nested submissions); spawn
                 # failure (e.g. shm store full) degrades to waiting.
                 fresh = self._try_spawn(self._max_workers)
+                if fresh is None:
+                    # At cap but idle ENV workers exist: evict one — the
+                    # mirror of _lease_env's default-worker eviction, so
+                    # neither sub-pool can starve behind the other's
+                    # reclaimable idle capacity.
+                    evicted = self._evict_idle_env_worker()
+                    if evicted:
+                        fresh = self._try_spawn(self._max_workers)
                 if fresh is not None:
                     return fresh
                 if _time.monotonic() >= deadline:
@@ -322,6 +330,18 @@ class WorkerPool:
             # Crashed while idle: replace and retry.
             self._replace(w)
 
+    def _evict_idle_env_worker(self) -> bool:
+        with self._lock:
+            queues = list(self._env_idle.values())
+        for q in queues:
+            try:
+                w = q.get_nowait()
+            except queue.Empty:
+                continue
+            self._remove_dead(w)
+            return True
+        return False
+
     def _lease_env(self, runtime_env, env_key: str,
                    timeout: float) -> WorkerProcess:
         """Lease a worker bound to a pip runtime env. The venv build is
@@ -331,8 +351,10 @@ class WorkerPool:
 
         with self._lock:
             q = self._env_idle.setdefault(env_key, queue.Queue())
-        deadline = _time.monotonic() + timeout
         python_exe = runtime_env.python_executable()  # builds on first use
+        # Deadline starts AFTER the build: a 90s first pip install must
+        # not eat the lease budget and fake pool exhaustion.
+        deadline = _time.monotonic() + timeout
         while True:
             if self._shutdown:
                 raise WorkerPoolExhaustedError("worker pool is shut down")

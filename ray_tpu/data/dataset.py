@@ -76,13 +76,50 @@ def _rebatch(blocks_iter: Iterator[Block], batch_size: Optional[int],
 
 
 class Dataset:
-    def __init__(self, operators: List[Operator]):
-        self._operators = operators
+    """A lazy pipeline: transforms append LOGICAL ops (data/logical.py);
+    consumption optimizes the logical plan (fusion, limit pushdown) and
+    only then plans physical operators for the streaming executor."""
+
+    def __init__(self, plan):
+        from ray_tpu.data.logical import LogicalPlan, physical_op
+
+        if isinstance(plan, LogicalPlan):
+            self._logical = plan
+        else:  # back-compat: a list of physical operators
+            self._logical = LogicalPlan([physical_op(op) for op in plan])
+        self._physical = None
         self._stats = None
+
+    @property
+    def _operators(self) -> List[Operator]:
+        """The optimized physical plan (cached per Dataset instance)."""
+        if self._physical is None:
+            self._physical = self._logical.optimize().to_physical()
+        return self._physical
+
+    def explain(self) -> str:
+        """Logical plan, optimized logical plan, and physical operators —
+        the reference's plan-introspection surface."""
+        opt = self._logical.optimize()
+        phys = " -> ".join(op.name for op in opt.to_physical())
+        return (f"Logical:   {self._logical.describe()}\n"
+                f"Optimized: {opt.describe()}\n"
+                f"Physical:  {phys}")
 
     # ------------------------------------------------------------ transforms
     def _append(self, op: Operator) -> "Dataset":
-        return Dataset(self._operators + [op])
+        from ray_tpu.data.logical import physical_op
+
+        return Dataset(self._logical.append(physical_op(op)))
+
+    def _append_map(self, name: str, block_fn,
+                    row_preserving: bool = False) -> "Dataset":
+        from ray_tpu.data.logical import LogicalOp
+
+        return Dataset(self._logical.append(LogicalOp(
+            kind="map", name=name, block_fn=block_fn,
+            row_preserving=row_preserving,
+            make_physical=lambda lo: MapOperator(lo.name, lo.block_fn))))
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = 4096,
                     batch_format: BatchFormat = None,
@@ -103,14 +140,15 @@ class Dataset:
                 out.append(normalize_block(result))
             return out or [block]
 
-        return self._append(MapOperator(f"MapBatches({_name(fn)})", block_fn))
+        return self._append_map(f"MapBatches({_name(fn)})", block_fn)
 
     def map(self, fn: Callable[[Dict], Dict], **_opts) -> "Dataset":
         def block_fn(block: Block) -> List[Block]:
             rows = [fn(r) for r in block_to_rows(block)]
             return [normalize_block(rows)] if rows else [block]
 
-        return self._append(MapOperator(f"Map({_name(fn)})", block_fn))
+        return self._append_map(f"Map({_name(fn)})", block_fn,
+                                row_preserving=True)
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]], **_opts) -> "Dataset":
         def block_fn(block: Block) -> List[Block]:
@@ -119,7 +157,7 @@ class Dataset:
                 rows.extend(fn(r))
             return [normalize_block(rows)] if rows else []
 
-        return self._append(MapOperator(f"FlatMap({_name(fn)})", block_fn))
+        return self._append_map(f"FlatMap({_name(fn)})", block_fn)
 
     def filter(self, fn: Callable[[Dict], bool], **_opts) -> "Dataset":
         def block_fn(block: Block) -> List[Block]:
@@ -128,7 +166,7 @@ class Dataset:
                 return []
             return [block_take_indices(block, np.nonzero(mask)[0])]
 
-        return self._append(MapOperator(f"Filter({_name(fn)})", block_fn))
+        return self._append_map(f"Filter({_name(fn)})", block_fn)
 
     def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
         def block_fn(block: Block) -> List[Block]:
@@ -137,22 +175,29 @@ class Dataset:
             out[name] = vals
             return [out]
 
-        return self._append(MapOperator(f"AddColumn({name})", block_fn))
+        return self._append_map(f"AddColumn({name})", block_fn,
+                                row_preserving=True)
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         def block_fn(block: Block) -> List[Block]:
             return [{k: v for k, v in block.items() if k not in cols}]
 
-        return self._append(MapOperator(f"DropColumns({cols})", block_fn))
+        return self._append_map(f"DropColumns({cols})", block_fn,
+                                row_preserving=True)
 
     def select_columns(self, cols: List[str]) -> "Dataset":
         def block_fn(block: Block) -> List[Block]:
             return [{k: block[k] for k in cols}]
 
-        return self._append(MapOperator(f"SelectColumns({cols})", block_fn))
+        return self._append_map(f"SelectColumns({cols})", block_fn,
+                                row_preserving=True)
 
     def limit(self, n: int) -> "Dataset":
-        return self._append(LimitOperator(n))
+        from ray_tpu.data.logical import LogicalOp
+
+        return Dataset(self._logical.append(LogicalOp(
+            kind="limit", name=f"Limit[{n}]", limit=n,
+            make_physical=lambda lo: LimitOperator(lo.limit))))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         def fn(blocks: List[Block]) -> List[Block]:

@@ -26,7 +26,11 @@ from ray_tpu.data.executor import InputOperator
 
 def _from_read_tasks(name: str, tasks: List[Callable[[], List[Block]]]
                      ) -> Dataset:
-    return Dataset([InputOperator(name, tasks)])
+    from ray_tpu.data.logical import LogicalOp, LogicalPlan
+
+    return Dataset(LogicalPlan([LogicalOp(
+        kind="read", name=name, read_tasks=tasks,
+        make_physical=lambda lo: InputOperator(lo.name, lo.read_tasks))]))
 
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001

@@ -14,7 +14,10 @@ from ray_tpu.util.scheduling_strategies import (
     PlacementGroupSchedulingStrategy,
 )
 
+from ray_tpu.util import pubsub  # noqa: F401 — general topic pub/sub
+
 __all__ = [
+    "pubsub",
     "DEFAULT",
     "NodeAffinitySchedulingStrategy",
     "PlacementGroup",

@@ -1,0 +1,75 @@
+"""General topic pub/sub (reference role: the GCS publisher/subscriber
+channels — ray/src/ray/pubsub + ray._private.gcs_pubsub [unverified]).
+
+Head-attached drivers publish/subscribe cluster-wide through the head's
+event channels (one-way pushes, at-most-once). A driver with no head
+attachment gets the same API over an in-process registry, so libraries
+can publish unconditionally.
+
+Built-in topics published by the head itself:
+
+- ``ray_tpu:node_events`` — ``{"event": "node_added"|"node_dead",
+  "client_id": ..., "node_id": ...}`` on membership changes.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+NODE_EVENTS_TOPIC = "ray_tpu:node_events"
+
+_local_lock = threading.Lock()
+_local_subs: Dict[str, List[Callable[[Any], None]]] = {}
+
+
+class LocalSubscription:
+    def __init__(self, topic: str):
+        self.topic = topic
+        self._queue: "_queue.Queue" = _queue.Queue()
+
+    def get(self, timeout: Optional[float] = None):
+        return self._queue.get(timeout=timeout)
+
+    def close(self):
+        with _local_lock:
+            handlers = _local_subs.get(self.topic, [])
+            if self._queue.put in handlers:
+                handlers.remove(self._queue.put)
+
+
+def _head_client():
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod._try_global_worker()
+    return getattr(w, "head_client", None) if w is not None else None
+
+
+def subscribe(topic: str, callback: Optional[Callable[[Any], None]] = None):
+    """Subscribe to a topic; returns a subscription whose ``.get(timeout)``
+    yields payloads (when no callback is given) and ``.close()`` stops it."""
+    hc = _head_client()
+    if hc is not None:
+        return hc.subscribe(topic, callback)
+    sub = LocalSubscription(topic)
+    with _local_lock:
+        _local_subs.setdefault(topic, []).append(
+            callback if callback is not None else sub._queue.put)
+    return sub
+
+
+def publish(topic: str, payload: Any) -> int:
+    """Publish to every subscriber; returns the number of clients (head
+    mode) or local handlers (driver-local mode) it was delivered to."""
+    hc = _head_client()
+    if hc is not None:
+        return hc.publish(topic, payload)
+    with _local_lock:
+        handlers = list(_local_subs.get(topic, ()))
+    for h in handlers:
+        try:
+            h(payload)
+        except Exception:  # noqa: BLE001 — subscriber callback bug
+            pass
+    return len(handlers)

@@ -352,3 +352,55 @@ def test_limit_early_terminates_upstream(ray_start_regular, tmp_path):
     assert len(rows) == 15
     assert len(glob.glob(f"{stamp_dir}/r*")) < 32, (
         "limit did not early-terminate the reads")
+
+
+def test_logical_plan_fusion_and_explain(ray_start_regular):
+    """map -> filter -> map_batches after a read collapses into the read
+    tasks; explain() shows the logical vs optimized vs physical plans."""
+    import ray_tpu.data as rdata
+
+    ds = (rdata.range(100)
+          .map(lambda r: {"id": r["id"] * 2})
+          .filter(lambda r: r["id"] % 4 == 0)
+          .map_batches(lambda b: b))
+    text = ds.explain()
+    assert "Logical:" in text and "Optimized:" in text
+    # Everything fused into ONE physical operator (the read).
+    assert len(ds._operators) == 1, ds.explain()
+    out = sorted(r["id"] for r in ds.iter_rows())
+    assert out == [i * 2 for i in range(100) if (i * 2) % 4 == 0]
+
+
+def test_logical_limit_pushdown_and_merge(ray_start_regular):
+    """A limit hops backward over 1:1 maps and adjacent limits merge —
+    visible in the optimized plan, invisible in the results."""
+    import ray_tpu.data as rdata
+    from ray_tpu.data.logical import (
+        limit_merge_rule,
+        limit_pushdown_rule,
+    )
+
+    ds = (rdata.range(50)
+          .map(lambda r: {"id": r["id"] + 1})
+          .limit(10)
+          .limit(7))
+    opt = ds._logical.optimize()
+    # The merged limit sits BEFORE the map in the optimized plan.
+    kinds = [op.kind for op in opt.ops]
+    limit_ops = [op for op in opt.ops if op.kind == "limit"]
+    assert len(limit_ops) == 1 and limit_ops[0].limit == 7
+    assert kinds.index("limit") < max(
+        i for i, op in enumerate(opt.ops) if "Map" in op.name)
+    rows = list(ds.iter_rows())
+    assert [r["id"] for r in rows] == list(range(1, 8))
+
+    # Rule unit behavior: pushdown does NOT cross a non-row-preserving op.
+    from ray_tpu.data.logical import LogicalOp
+
+    flat = LogicalOp(kind="map", name="FlatMap", block_fn=lambda b: [b],
+                     make_physical=lambda lo: None, row_preserving=False)
+    lim = LogicalOp(kind="limit", name="Limit[3]", limit=3,
+                    make_physical=lambda lo: None)
+    assert [o.name for o in limit_pushdown_rule([flat, lim])] == [
+        "FlatMap", "Limit[3]"]
+    assert limit_merge_rule([lim, lim])[0].limit == 3

@@ -238,3 +238,94 @@ def test_head_restart_recovers(tmp_path):
             head2.wait(timeout=5)
     finally:
         ray_tpu.shutdown()
+
+
+_PUBSUB_PEER = r"""
+import sys, time
+import ray_tpu
+from ray_tpu.util import pubsub
+
+address = sys.argv[1]
+ray_tpu.init(num_cpus=1, worker_mode="thread", address=address)
+w = ray_tpu._private.worker.global_worker()
+sub = pubsub.subscribe("test:topic")
+w.kv_put(b"pubsub/ready", b"1")
+msg = sub.get(timeout=30)
+w.kv_put(b"pubsub/got", repr(msg).encode())
+deadline = time.time() + 30
+while time.time() < deadline:
+    if w.kv_get(b"pubsub/done") is not None:
+        break
+    time.sleep(0.05)
+ray_tpu.shutdown()
+"""
+
+
+def test_pubsub_cross_driver(head_proc):
+    """General pub/sub: a peer driver's subscription receives a payload
+    published by this driver through the head (GCS publisher role)."""
+    peer = subprocess.Popen(
+        [sys.executable, "-c", _PUBSUB_PEER, head_proc],
+        env=dict(os.environ))
+    try:
+        ray_tpu.init(num_cpus=1, worker_mode="thread", address=head_proc)
+        w = ray_tpu._private.worker.global_worker()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if w.kv_get(b"pubsub/ready") is not None:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("peer never subscribed")
+        from ray_tpu.util import pubsub
+
+        # Head pushes to the one subscriber (the peer).
+        n = pubsub.publish("test:topic", {"x": 41})
+        assert n == 1
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            got = w.kv_get(b"pubsub/got")
+            if got is not None:
+                assert b"41" in got
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("peer never received the publish")
+        w.kv_put(b"pubsub/done", b"1")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            peer.wait(timeout=30)
+
+
+def test_pubsub_node_events(head_proc):
+    """The head itself publishes membership changes on the built-in
+    node-events topic: a node joining is observed by a subscribed
+    driver."""
+    from ray_tpu._private.head_client import HeadClient
+
+    sub_client = HeadClient(head_proc)
+    pub_client = HeadClient(head_proc)
+    try:
+        sub = sub_client.subscribe("ray_tpu:node_events")
+        pub_client.node_register("nodeA", {"CPU": 2})
+        evt = sub.get(timeout=10)
+        assert evt["event"] == "node_added"
+        assert evt["node_id"] == "nodeA"
+    finally:
+        sub_client.close()
+        pub_client.close()
+
+
+def test_pubsub_local_fallback():
+    """Without a head attachment the same API works in-process."""
+    from ray_tpu.util import pubsub
+
+    sub = pubsub.subscribe("local:topic")
+    try:
+        assert pubsub.publish("local:topic", 7) == 1
+        assert sub.get(timeout=5) == 7
+    finally:
+        sub.close()
+    assert pubsub.publish("local:topic", 8) == 0
