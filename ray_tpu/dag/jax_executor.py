@@ -36,9 +36,11 @@ and compile with zero collectives; a fully-connected fan-in degenerates to
 a whole-wave gather. The HBM cost of replicating the table
 (``num_slots × payload``) is the deliberate trade for static single-pass
 scatters; the ICI cost is proportional to actual cross-shard edges, not
-wave width. The dynamic frontier mode still gathers the whole masked
-frontier (its ready set is unknown at compile time), with the in-degree
-vector and done mask kept replicated.
+wave width. The dynamic frontier mode ships each
+shard's top-F chosen outputs + ids per iteration (the in-degree vector
+and done mask stay replicated) — unless the graph partitions cleanly
+across shards, in which case only the tiny id vectors ride ICI and the
+leaves replicate once after the loop with a masked psum.
 """
 
 from __future__ import annotations
@@ -780,6 +782,21 @@ def compile_jax_dag(
             done0_pad = np.zeros(C_pad, bool)
             done0_pad[C:] = True  # padding tasks are born finished
             ids_np = np.arange(C_pad, dtype=np.int32).reshape(n_sh, Cn)
+            # Shard-partitioned graphs (every data edge stays inside its
+            # owner's contiguous block) skip the per-iteration PAYLOAD
+            # all_gather entirely: only the fired task ids (tiny int32
+            # vectors) ride ICI each step, and the replicated outputs are
+            # assembled ONCE after the loop with a psum over leaf owners.
+            cross_payload = any(
+                (s // Cn) != (d // Cn)
+                for s, d in zip(edges_src, edges_dst))
+            # leaf slot j's owner shard (0 for input-slot leaves, which
+            # every shard holds identically).
+            leaf_prod = [compact_producer.get(int(s))
+                         for s in leaf_slots.tolist()]
+            leaf_owner_np = np.asarray(
+                [(p // Cn if p is not None else 0) for p in leaf_prod],
+                np.int32)
 
             def _sharded_dynamic(inputs):
                 # Owned-task ids as a trace-time literal indexed by shard
@@ -807,13 +824,18 @@ def compile_jax_dag(
                     valid = mine[sel]
                     t_idx = jnp.where(valid, chosen, -1)
                     outs = _compute_tasks(obj, t_idx)    # [F, *P]
-                    g_outs = lax.all_gather(
-                        outs, mesh_axis, axis=0, tiled=True)  # [nF, *P]
+                    my_chosen = jnp.where(valid, chosen, C_pad)
                     g_ids = lax.all_gather(
-                        jnp.where(valid, chosen, C_pad), mesh_axis,
-                        axis=0, tiled=True)              # [nF]
-                    obj = obj.at[jnp.asarray(out_slots_ext)[g_ids]].set(
-                        g_outs)
+                        my_chosen, mesh_axis, axis=0, tiled=True)  # [nF]
+                    if cross_payload:
+                        g_outs = lax.all_gather(
+                            outs, mesh_axis, axis=0, tiled=True)  # [nF,*P]
+                        obj = obj.at[jnp.asarray(out_slots_ext)[g_ids]].set(
+                            g_outs)
+                    else:
+                        # Consumers are all local: write own outputs only.
+                        obj = obj.at[
+                            jnp.asarray(out_slots_ext)[my_chosen]].set(outs)
                     fired = (jnp.zeros(C_pad + 1, bool).at[g_ids].set(True)
                              )[:C_pad]
                     done = done | fired
@@ -825,6 +847,14 @@ def compile_jax_dag(
 
                 obj, _, _ = lax.while_loop(cond, body, (obj, indeg, done))
                 out = obj[jnp.asarray(leaf_slots)]
+                if not cross_payload:
+                    # Leaves live only on their producer shard; replicate
+                    # once with a single masked psum (out_specs is P()).
+                    sh = lax.axis_index(mesh_axis)
+                    mask = (jnp.asarray(leaf_owner_np) == sh)
+                    shape = (mask.shape[0],) + (1,) * (out.ndim - 1)
+                    out = lax.psum(
+                        jnp.where(mask.reshape(shape), out, 0), mesh_axis)
                 return out if multi_output else out[0]
 
             sharded_fn = jax.jit(jax.shard_map(
@@ -835,7 +865,7 @@ def compile_jax_dag(
             def program(inputs):
                 return sharded_fn(inputs)
 
-            program.export_width = F
+            program.export_width = F if cross_payload else 0
             program.lanes_per_shard = Cn
 
     fn = program if mesh is not None else jax.jit(program)

@@ -545,3 +545,33 @@ def test_visualize_schedule_names_exports(ray_start_regular):
         backend="jax", payload_shape=(4,), fuse=False)
     stext = single.visualize_schedule()
     assert "wave 0:" in stext and "inc->s" in stext
+
+
+def test_jax_sharded_dynamic_partitioned_skips_payload_exchange(
+        ray_start_regular):
+    """A shard-partitioned dynamic DAG (every data edge local to its
+    owner block) moves NO payloads during the frontier loop — only task
+    ids — and replicates leaves once at the end. export_width == 0
+    records the compile-time proof."""
+    from ray_tpu.dag import MultiOutputNode
+
+    with InputNode() as inp:
+        chains = []
+        for _ in range(8):
+            node = inp
+            for _ in range(5):
+                node = inc.bind(node)
+            chains.append(node)
+        dag = MultiOutputNode(chains)
+    sharded = dag.experimental_compile(
+        backend="jax", payload_shape=(4,), dynamic=True,
+        mesh=_dag_mesh(), mesh_axis="dag")
+    assert sharded.export_width == 0
+    single = dag.experimental_compile(
+        backend="jax", payload_shape=(4,), dynamic=True)
+    x = np.arange(4, dtype=np.float32)
+    got = sharded.execute(x).get()
+    want = single.execute(x).get()
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-6)
+        np.testing.assert_allclose(g, x + 5, rtol=1e-6)
