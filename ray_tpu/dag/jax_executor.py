@@ -866,6 +866,7 @@ def compile_jax_dag(
                 return sharded_fn(inputs)
 
             program.export_width = F if cross_payload else 0
+            program.frontier_lanes = F
             program.lanes_per_shard = Cn
 
     fn = program if mesh is not None else jax.jit(program)
@@ -885,6 +886,6 @@ def compile_jax_dag(
             "mode": "dynamic",
             "tasks": [(ci, f[4], int(f[2])) for ci, f in enumerate(fused)],
             "n_edges": len(edges_src),
-            "frontier_width": getattr(program, "export_width", None),
+            "frontier_width": getattr(program, "frontier_lanes", None),
         }
     return dag

@@ -563,15 +563,19 @@ def test_jax_sharded_dynamic_partitioned_skips_payload_exchange(
                 node = inc.bind(node)
             chains.append(node)
         dag = MultiOutputNode(chains)
-    sharded = dag.experimental_compile(
-        backend="jax", payload_shape=(4,), dynamic=True,
-        mesh=_dag_mesh(), mesh_axis="dag")
-    assert sharded.export_width == 0
-    single = dag.experimental_compile(
-        backend="jax", payload_shape=(4,), dynamic=True)
     x = np.arange(4, dtype=np.float32)
-    got = sharded.execute(x).get()
-    want = single.execute(x).get()
-    for g, w in zip(got, want):
-        np.testing.assert_allclose(g, w, rtol=1e-6)
-        np.testing.assert_allclose(g, x + 5, rtol=1e-6)
+    # fuse=False keeps the intra-chain edges: each shard's later tasks
+    # READ earlier locally-written outputs across loop iterations —
+    # the path the no-exchange mode must keep correct.
+    for fuse in (True, False):
+        sharded = dag.experimental_compile(
+            backend="jax", payload_shape=(4,), dynamic=True, fuse=fuse,
+            mesh=_dag_mesh(), mesh_axis="dag")
+        assert sharded.export_width == 0
+        single = dag.experimental_compile(
+            backend="jax", payload_shape=(4,), dynamic=True, fuse=fuse)
+        got = sharded.execute(x).get()
+        want = single.execute(x).get()
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+            np.testing.assert_allclose(g, x + 5, rtol=1e-6)
