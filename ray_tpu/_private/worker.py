@@ -384,6 +384,14 @@ class Worker:
         cluster = getattr(self, "cluster", None)
         routed = (cluster is None and self.remote_router is not None
                   and self.remote_router.maybe_route(spec))
+        if (not routed and getattr(self, "client_mode", False)
+                and not self.resource_pool.fits(spec.resources)):
+            for ref in dep_refs:  # undo the submitted-ref pins
+                self.store.remove_submitted_ref(ref.object_id)
+            raise RayTpuError(
+                "client-mode driver (ray://) has no local execution "
+                "capacity and no feasible cluster node accepted the task "
+                "— start node daemons with `ray-tpu start --address=`")
         if not routed:
             # Remote results have no local producer — their bytes arrive
             # by head-relayed pull, which a producer mark would suppress.
@@ -529,10 +537,24 @@ def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
             from ray_tpu._private.head_service import DEFAULT_PORT
 
             address = f"127.0.0.1:{DEFAULT_PORT}"
+        client_mode = bool(address) and address.startswith("ray://")
+        if client_mode:
+            # Ray-Client role: a THIN attach — this process keeps no task
+            # execution capacity (num_cpus=0, no process pool); every
+            # .remote() routes onto the cluster's node daemons through
+            # the head, and results pull back on demand. Actors created
+            # here still live in this process (cross-driver named actors
+            # resolve cluster-wide as usual).
+            address = address[len("ray://"):]
+            num_cpus = 0
+            num_tpus = 0
+            resources = {}
+            worker_mode = worker_mode or "thread"
         _global_worker = Worker(num_cpus=num_cpus, num_tpus=num_tpus,
                                 resources=resources,
                                 worker_mode=worker_mode,
                                 head_address=address)
+        _global_worker.client_mode = client_mode
         _global_worker.namespace = namespace
         atexit.register(shutdown)
         return _global_worker

@@ -15,11 +15,21 @@ from ray_tpu.rl.dqn import DQNConfig, DQNLearner
 from ray_tpu.rl.replay import ReplayBuffer
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.multi_agent import (
+    CoordinationGame,
+    MultiAgentEnvRunner,
+    MultiAgentJaxEnv,
+    MultiAgentPPO,
+)
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "CartPole",
+    "CoordinationGame",
+    "MultiAgentEnvRunner",
+    "MultiAgentJaxEnv",
+    "MultiAgentPPO",
     "DQNConfig",
     "DQNLearner",
     "EnvRunner",

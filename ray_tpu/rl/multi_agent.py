@@ -1,0 +1,210 @@
+"""Multi-agent RL (reference role: rllib MultiAgentEnv +
+multi_agent_env_runner + the policy-mapping / independent-learner setup
+of rllib's multi-agent training [unverified]).
+
+TPU-first shape: a MultiAgentJaxEnv steps ALL agents simultaneously as
+pure functions, so the per-agent policy forwards, the joint env step,
+and the whole T-step rollout fuse into one jitted ``lax.scan`` — one
+device program collects every agent's trajectory at once. Training is
+independent PPO per policy (agents may share a policy via the mapping),
+each update reusing the single-agent jitted PPO learner over the
+concatenated rollouts of the agents mapped to it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from types import SimpleNamespace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.ppo import (
+    PPOConfig,
+    PPOLearner,
+    Rollout,
+    policy_logits,
+    value_fn,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiAgentJaxEnv:
+    """Simultaneous-move multi-agent env as pure functions.
+
+    reset(key) -> (state, obs: {agent: [obs_dim]})
+    step(state, actions: {agent: scalar}, key)
+        -> (state, obs, rewards: {agent: scalar}, done: scalar)
+    """
+
+    agents: Tuple[str, ...]
+    reset: Callable
+    step: Callable
+    obs_dims: Dict[str, int]
+    num_actions: Dict[str, int]
+    max_episode_steps: int
+
+
+def CoordinationGame(num_actions: int = 4,
+                     episode_len: int = 32) -> MultiAgentJaxEnv:
+    """Two-player repeated coordination game: both agents earn +1 when
+    they pick the SAME action, 0 otherwise. Observations are the one-hot
+    previous joint action — enough signal for independent learners to
+    converge on a convention (it is a potential game)."""
+    agents = ("a0", "a1")
+    obs_dim = 2 * num_actions
+
+    def _obs(last0, last1):
+        o = jnp.concatenate([
+            jax.nn.one_hot(last0, num_actions),
+            jax.nn.one_hot(last1, num_actions)])
+        return {"a0": o, "a1": o}
+
+    def reset(key):
+        state = (jnp.zeros((), jnp.int32),            # t
+                 -jnp.ones((), jnp.int32),            # last a0 (-1 = none)
+                 -jnp.ones((), jnp.int32))            # last a1
+        o = jnp.zeros((obs_dim,))
+        return state, {"a0": o, "a1": o}
+
+    def step(state, actions, key):
+        t, _, _ = state
+        a0, a1 = actions["a0"], actions["a1"]
+        r = (a0 == a1).astype(jnp.float32)
+        t2 = t + 1
+        done = t2 >= episode_len
+        t_next = jnp.where(done, 0, t2)
+        obs = _obs(a0, a1)
+        zero = jnp.zeros((obs_dim,))
+        obs = {k: jnp.where(done, zero, v) for k, v in obs.items()}
+        state2 = (t_next, a0, a1)
+        return state2, obs, {"a0": r, "a1": r}, done
+
+    return MultiAgentJaxEnv(
+        agents=agents, reset=reset, step=step,
+        obs_dims={a: obs_dim for a in agents},
+        num_actions={a: num_actions for a in agents},
+        max_episode_steps=episode_len)
+
+
+def make_multi_rollout_fn(env: MultiAgentJaxEnv, rollout_len: int,
+                          policy_of: Dict[str, str]):
+    """(params_by_policy, state, obs, key) -> ({agent: Rollout}, state,
+    obs, key), one jitted program: every agent's policy forward, the
+    joint step, and the scan over T fuse together."""
+
+    def step_once(carry, key):
+        params_by_policy, state, obs = carry
+        k_act, k_env = jax.random.split(key)
+        n = obs[env.agents[0]].shape[0]
+        actions, logps, values = {}, {}, {}
+        akeys = jax.random.split(k_act, len(env.agents))
+        for i, ag in enumerate(env.agents):
+            p = params_by_policy[policy_of[ag]]
+            logits = policy_logits(p, obs[ag])           # [N, A]
+            a = jax.random.categorical(akeys[i], logits)
+            actions[ag] = a
+            logps[ag] = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), a[:, None], -1)[:, 0]
+            values[ag] = value_fn(p, obs[ag])
+        state, obs_next, rewards, done = jax.vmap(
+            env.step, in_axes=(0, 0, 0))(
+                state, actions, jax.random.split(k_env, n))
+        out = ({ag: obs[ag] for ag in env.agents}, actions, logps,
+               rewards, done, values)
+        return (params_by_policy, state, obs_next), out
+
+    def rollout(params_by_policy, state, obs, key):
+        keys = jax.random.split(key, rollout_len)
+        (params_by_policy, state, obs_last), outs = jax.lax.scan(
+            step_once, (params_by_policy, state, obs), keys)
+        obs_b, actions, logps, rewards, dones, values = outs
+        rollouts = {}
+        for ag in env.agents:
+            v_last = value_fn(
+                params_by_policy[policy_of[ag]], obs_last[ag])
+            vals = jnp.concatenate([values[ag], v_last[None]], axis=0)
+            rollouts[ag] = Rollout(
+                obs_b[ag], actions[ag], logps[ag], rewards[ag],
+                dones, vals)
+        return rollouts, state, obs_last
+
+    return jax.jit(rollout)
+
+
+class MultiAgentEnvRunner:
+    """Vectorized multi-agent rollout collection: N parallel copies of
+    the joint env, all agents stepped inside one device program."""
+
+    def __init__(self, env: MultiAgentJaxEnv, num_envs: int = 32,
+                 rollout_len: int = 64,
+                 policy_of: Optional[Dict[str, str]] = None, seed: int = 0):
+        self.env = env
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.policy_of = policy_of or {a: a for a in env.agents}
+        self._key = jax.random.PRNGKey(seed)
+        self._key, rk = jax.random.split(self._key)
+        self._state, self._obs = jax.vmap(env.reset)(
+            jax.random.split(rk, num_envs))
+        self._rollout = make_multi_rollout_fn(
+            env, rollout_len, self.policy_of)
+
+    def sample(self, params_by_policy) -> Dict[str, Rollout]:
+        self._key, k = jax.random.split(self._key)
+        rollouts, self._state, self._obs = self._rollout(
+            params_by_policy, self._state, self._obs, k)
+        return rollouts
+
+    def steps_per_sample(self) -> int:
+        return self.num_envs * self.rollout_len * len(self.env.agents)
+
+
+def _concat_rollouts(rollouts: List[Rollout]) -> Rollout:
+    if len(rollouts) == 1:
+        return rollouts[0]
+    return Rollout(*[jnp.concatenate(parts, axis=1)
+                     for parts in zip(*rollouts)])
+
+
+class MultiAgentPPO:
+    """Independent PPO over a policy mapping: one jitted PPO learner per
+    policy id; agents sharing a policy pool their trajectories into one
+    update batch (rllib's shared-policy semantics)."""
+
+    def __init__(self, env: MultiAgentJaxEnv,
+                 policy_of: Optional[Dict[str, str]] = None,
+                 config: PPOConfig = PPOConfig(), num_envs: int = 32,
+                 rollout_len: int = 64, seed: int = 0):
+        self.env = env
+        self.policy_of = policy_of or {a: a for a in env.agents}
+        self.runner = MultiAgentEnvRunner(
+            env, num_envs=num_envs, rollout_len=rollout_len,
+            policy_of=self.policy_of, seed=seed)
+        self.learners: Dict[str, PPOLearner] = {}
+        for i, pid in enumerate(sorted(set(self.policy_of.values()))):
+            # Any agent mapped to this policy defines its spaces.
+            ag = next(a for a, p in self.policy_of.items() if p == pid)
+            shim = SimpleNamespace(obs_dim=env.obs_dims[ag],
+                                   num_actions=env.num_actions[ag])
+            self.learners[pid] = PPOLearner(
+                shim, config=config, seed=seed + i)
+        self._key = jax.random.PRNGKey(seed + 10_000)
+
+    def weights(self) -> Dict[str, Any]:
+        return {pid: lr.get_weights() for pid, lr in self.learners.items()}
+
+    def train(self) -> Dict[str, Any]:
+        rollouts = self.runner.sample(self.weights())
+        losses = {}
+        for pid, learner in self.learners.items():
+            mine = [rollouts[a] for a, p in self.policy_of.items()
+                    if p == pid]
+            self._key, k = jax.random.split(self._key)
+            losses[pid] = learner.update(_concat_rollouts(mine), k)
+        mean_reward = float(np.mean(
+            [np.asarray(r.rewards).mean() for r in rollouts.values()]))
+        return {"mean_step_reward": mean_reward, "losses": losses,
+                "env_steps": self.runner.steps_per_sample()}

@@ -146,8 +146,9 @@ def test_threaded_actor(ray_start_regular):
     start = time.monotonic()
     refs = [a.slow.remote() for _ in range(4)]
     assert sum(ray_tpu.get(refs, timeout=30)) == 4
-    # 4 concurrent 0.2s sleeps should take well under 0.8s sequential time.
-    assert time.monotonic() - start < 0.7
+    # 4 concurrent 0.2s sleeps must beat the 0.8s+dispatch a sequential
+    # execution needs; 0.78 keeps headroom for 1-core scheduler jitter.
+    assert time.monotonic() - start < 0.78
 
 
 def test_method_num_returns(ray_start_regular):

@@ -208,3 +208,54 @@ def test_inflight_tasks_reroute_off_dead_node(two_node_cluster):
     cluster["node2"].wait(timeout=5)
     results = ray_tpu.get(pin + [victim], timeout=120)
     assert results == ["done"] * 3
+
+
+def test_ray_client_mode_routes_to_cluster(tmp_path):
+    """`init(address="ray://...")` is the thin-client role: the local
+    process keeps zero execution capacity and every task lands on a node
+    daemon (reference: ray client semantics)."""
+    ray_tpu.shutdown()
+    head, address = _spawn_head(tmp_path)
+    node = None
+    try:
+        node = _spawn_node(address, 2, '{"n1": 1}')
+        ray_tpu.init(address=f"ray://{address}")
+        w = ray_tpu._private.worker.global_worker()
+        assert w.client_mode
+        assert w.resource_pool.total.get("CPU", 0) == 0
+
+        @ray_tpu.remote
+        def where():
+            return os.getpid()
+
+        pids = set(ray_tpu.get([where.remote() for _ in range(4)],
+                               timeout=60))
+        assert os.getpid() not in pids  # nothing ran in the client
+    finally:
+        ray_tpu.shutdown()
+        for p in (node, head):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=5)
+
+
+def test_ray_client_mode_without_nodes_errors(tmp_path):
+    """A client-mode task with no cluster capacity fails loudly instead
+    of hanging on an infeasible local queue."""
+    from ray_tpu.exceptions import RayTpuError
+
+    ray_tpu.shutdown()
+    head, address = _spawn_head(tmp_path)
+    try:
+        ray_tpu.init(address=f"ray://{address}")
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        with pytest.raises(RayTpuError, match="client-mode"):
+            f.remote()
+    finally:
+        ray_tpu.shutdown()
+        head.kill()
+        head.wait(timeout=5)
