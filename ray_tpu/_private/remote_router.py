@@ -119,7 +119,8 @@ class RemoteRouter:
             isinstance(strat, NodeAffinitySchedulingStrategy)
             and any(n.get("node_id") == strat.node_id
                     for n in self.nodes()))
-        local_fits = self.worker.resource_pool.fits(spec.resources)
+        local_fits = (self.worker.resource_pool.fits(spec.resources)
+                      and not getattr(self.worker, "client_mode", False))
         spill = False
         if local_fits and not affinity_remote:
             backlog = self.worker.scheduler.backlog_size()

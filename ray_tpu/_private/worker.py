@@ -384,8 +384,10 @@ class Worker:
         cluster = getattr(self, "cluster", None)
         routed = (cluster is None and self.remote_router is not None
                   and self.remote_router.maybe_route(spec))
-        if (not routed and getattr(self, "client_mode", False)
-                and not self.resource_pool.fits(spec.resources)):
+        if not routed and getattr(self, "client_mode", False):
+            # Thin clients never execute locally — zero-resource tasks
+            # included; an unroutable task fails loudly instead of
+            # queueing against capacity that will never exist here.
             for ref in dep_refs:  # undo the submitted-ref pins
                 self.store.remove_submitted_ref(ref.object_id)
             raise RayTpuError(

@@ -14,6 +14,12 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.callbacks import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+)
+from ray_tpu.train.huggingface import TransformersTrainer
 from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
@@ -27,9 +33,13 @@ from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
 DataParallelTrainer = JaxTrainer
 
 __all__ = [
+    "CSVLoggerCallback",
+    "Callback",
     "Checkpoint",
     "CheckpointConfig",
     "DataParallelTrainer",
+    "JsonLoggerCallback",
+    "TransformersTrainer",
     "FailureConfig",
     "JaxTrainer",
     "Result",

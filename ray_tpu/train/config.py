@@ -36,3 +36,5 @@ class RunConfig:
         default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
+    # Result-stream hooks (train/callbacks.py) — the AIR integrations row.
+    callbacks: list = dataclasses.field(default_factory=list)

@@ -49,6 +49,19 @@ class TrainContext:
     def get_trial_name(self) -> str:
         return self.trial_name
 
+    @property
+    def collective_group(self) -> str:
+        """The worker group's actor-plane collective group name (joined
+        by every worker before the loop runs)."""
+        return _group_name(self.run_id)
+
+
+
+def _group_name(run_id: str) -> str:
+    """THE definition of a run's collective group name — trainer and
+    session must agree or DP collectives join a group nobody set up."""
+    return f"train-{run_id}"
+
 
 def _set_context(ctx: Optional[TrainContext]):
     _local.ctx = ctx

@@ -59,14 +59,14 @@ class JaxTrainer:
         latest_ckpt: Optional[Checkpoint] = None
         history: List[Dict[str, Any]] = []
         attempt = 0
-        while True:
+        result = None
+        while result is None:
             try:
                 metrics, ckpt, hist = self._run_attempt(latest_ckpt)
                 history.extend(hist)
                 result = Result(metrics=metrics, checkpoint=ckpt,
                                 metrics_history=history,
                                 path=self._storage_dir())
-                return result
             except Exception as exc:  # noqa: BLE001 — group failure boundary
                 attempt += 1
                 # Carry forward any checkpoint reported before the failure.
@@ -76,6 +76,15 @@ class JaxTrainer:
                     raise TrainingFailedError(
                         f"training failed after {attempt - 1} restart(s): "
                         f"{exc!r}") from exc
+        # Callbacks close OUTSIDE the retry boundary: a logger bug must
+        # not discard a completed training run (per-record on_result
+        # already streamed live from _run_attempt's drain loop).
+        for cb in self._run_config.callbacks:
+            try:
+                cb.on_end(result)
+            except Exception:  # noqa: BLE001 — logger bug, not training
+                pass
+        return result
 
     def _storage_dir(self) -> Optional[str]:
         rc = self._run_config
@@ -89,7 +98,9 @@ class JaxTrainer:
     def _run_attempt(self, restore_from: Optional[Checkpoint]):
         n = self._scaling.total_workers
         run_id = f"run-{id(self)}-{time.monotonic_ns()}"
-        group_name = f"train-{run_id}"
+        from ray_tpu.train.session import _group_name
+
+        group_name = _group_name(run_id)
 
         # Shard datasets per worker (Dataset.split) once per attempt.
         shards_per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
@@ -157,6 +168,11 @@ class JaxTrainer:
                     metrics, ckpt = _pickle.loads(raw)
                     history.append(metrics)
                     latest_metrics = metrics
+                    for cb in self._run_config.callbacks:
+                        try:  # live stream; a logger bug must not fail
+                            cb.on_result(metrics)  # the training group
+                        except Exception:  # noqa: BLE001
+                            pass
                     if ckpt is not None:
                         latest_ckpt = self._persist(ckpt)
 

@@ -1,0 +1,105 @@
+"""TransformersTrainer: fine-tune a (config-constructed, offline) Flax
+transformers model through the JaxTrainer worker group, with DP gradient
+averaging over the actor-plane collective and logger callbacks
+(reference roles: ray/train/huggingface TransformersTrainer + AIR
+logger callbacks)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rdata
+from ray_tpu.train import (
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    RunConfig,
+    ScalingConfig,
+    TransformersTrainer,
+)
+
+
+def _tiny_bert():
+    from transformers import BertConfig, FlaxBertForSequenceClassification
+
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, num_labels=2)
+    return FlaxBertForSequenceClassification(cfg, seed=0)
+
+
+def _toy_dataset(n=128, seq=8):
+    # Separable: label 1 iff token 3 appears in the sequence.
+    rng = np.random.default_rng(0)
+    ids = rng.integers(4, 64, size=(n, seq)).astype(np.int32)
+    labels = rng.integers(0, 2, size=n).astype(np.int32)
+    ids[labels == 1, 0] = 3
+    return rdata.from_columns({
+        "input_ids": ids,
+        "attention_mask": np.ones((n, seq), np.int32),
+        "labels": labels,
+    }, parallelism=2)
+
+
+def test_transformers_trainer_learns(ray_start_regular, tmp_path):
+    trainer = TransformersTrainer(
+        model_init=_tiny_bert,
+        num_epochs=4,
+        batch_size=32,
+        report_every=1,
+        run_config=RunConfig(callbacks=[
+            JsonLoggerCallback(str(tmp_path)),
+            CSVLoggerCallback(str(tmp_path)),
+        ]),
+        datasets={"train": _toy_dataset()},
+    )
+    result = trainer.fit()
+    hist = [h for h in result.metrics_history if "loss" in h]
+    assert len(hist) >= 4
+    first, last = hist[0]["loss"], hist[-1]["loss"]
+    assert last < first, (first, last)
+
+    # Callbacks wrote the result stream.
+    lines = open(os.path.join(tmp_path, "result.json")).read().splitlines()
+    assert len(lines) == len(result.metrics_history)
+    assert "loss" in json.loads(lines[0])
+    csv_head = open(os.path.join(tmp_path, "progress.csv")).readline()
+    assert "loss" in csv_head
+
+
+def test_transformers_trainer_data_parallel(ray_start_regular):
+    """Two DP workers average gradients through the collective group;
+    both ranks report and the loss stays finite."""
+    trainer = TransformersTrainer(
+        model_init=_tiny_bert,
+        num_epochs=1,
+        batch_size=32,
+        report_every=1,
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": _toy_dataset()},
+    )
+    result = trainer.fit()
+    # The result stream follows rank 0 (reference semantics); completing
+    # at all proves both ranks joined — every allreduce round blocks
+    # until world_size participants post.
+    assert {h.get("rank") for h in result.metrics_history} == {0}
+    assert all(np.isfinite(h["loss"]) for h in result.metrics_history)
+
+
+def test_transformers_trainer_uneven_shards(ray_start_regular):
+    """Shards whose batch counts differ must not deadlock the per-step
+    allreduce: ranks agree on the min step count (drop-tail DP)."""
+    trainer = TransformersTrainer(
+        model_init=_tiny_bert,
+        num_epochs=1,
+        batch_size=32,
+        report_every=1,
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": _toy_dataset(n=97)},  # 49/48 split -> 2/2... or 2/1 batches
+    )
+    result = trainer.fit()
+    assert result.metrics_history, "no reports"
+    assert np.isfinite(result.metrics_history[-1]["loss"])
