@@ -112,11 +112,16 @@ class WorkerProcess:
         # plane), and they must be able to import test/user modules the
         # driver loaded from sys.path-only locations.
         full_env["RAY_TPU_WORKER_MODE"] = "thread"
-        # Workers never touch the TPU; dropping the axon trigger skips the
+        # Workers never touch the TPU (the device belongs to the driver's
+        # compiled-graph path); dropping the axon trigger skips the
         # sitecustomize jax/PJRT registration (~2.2s of the ~2.4s worker
-        # boot) so the pool spins up in ~0.2s per process.
+        # boot) so the pool spins up in ~0.2s per process. The platform is
+        # FORCED, not defaulted: a driver running under a tunneled-TPU
+        # JAX_PLATFORMS would otherwise hand workers a platform whose
+        # plugin trigger was just stripped, and any task importing jax
+        # dies with "unknown backend".
         full_env.pop("PALLAS_AXON_POOL_IPS", None)
-        full_env.setdefault("JAX_PLATFORMS", "cpu")
+        full_env["JAX_PLATFORMS"] = "cpu"
         extra_path = [p for p in sys.path if p]
         prev = full_env.get("PYTHONPATH", "")
         full_env["PYTHONPATH"] = os.pathsep.join(
