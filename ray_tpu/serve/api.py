@@ -170,15 +170,127 @@ def get_deployment_handle(name: str, app_name: str = "default"
     return DeploymentHandle(name, get_or_create_controller())
 
 
-def ingress(fastapi_app=None):
-    """FastAPI ingress shim: framework-HTTP is served by serve.http's thin
-    proxy; this decorator marks the class for route extraction."""
+def ingress(asgi_app=None):
+    """ASGI ingress (reference role: serve's FastAPI ingress —
+    ``@serve.ingress(app)``). Works with ANY ASGI-3 application (FastAPI,
+    Starlette, or a plain callable); this image ships no ASGI framework,
+    so the contract is the protocol itself. The decorator injects a
+    ``__serve_asgi__`` replica method that drives the app for one HTTP
+    request; the proxy routes ``/<deployment>/<subpath>`` through it with
+    ``path=/<subpath>``."""
 
     def wrap(cls):
-        cls.__serve_ingress__ = fastapi_app
+        cls.__serve_ingress__ = asgi_app
+
+        def __serve_asgi__(self, request: dict) -> dict:
+            app = type(self).__serve_ingress__
+            if app is None:
+                raise ValueError("no ASGI app bound to this deployment")
+            runner = getattr(self, "_serve_asgi_runner", None)
+            if runner is None:
+                runner = _AsgiRunner(app)
+                self._serve_asgi_runner = runner
+            return runner.handle(request)
+
+        cls.__serve_asgi__ = __serve_asgi__
         return cls
 
     return wrap
+
+
+class _AsgiRunner:
+    """Per-replica ASGI host: one persistent event loop thread for the
+    app (not a fresh asyncio.run per request) with the lifespan protocol
+    driven ONCE at startup — FastAPI/Starlette startup handlers (DB
+    pools, model loads) run before the first request, as under uvicorn.
+    Apps that do not speak lifespan are tolerated (the spec allows
+    rejecting it)."""
+
+    def __init__(self, app):
+        import asyncio
+        import queue as _queue
+
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop_main, daemon=True, name="serve-asgi-loop")
+        self._thread.start()
+
+        self._lifespan_q: "_queue.Queue" = _queue.Queue()
+        started = threading.Event()
+        state: dict = {}
+
+        async def lifespan():
+            scope = {"type": "lifespan", "asgi": {"version": "3.0"},
+                     "state": state}
+            incoming = [{"type": "lifespan.startup"}]
+
+            async def receive():
+                if incoming:
+                    return incoming.pop(0)
+                # Block until shutdown (never, for replica lifetime).
+                return await asyncio.get_event_loop().create_future()
+
+            async def send(msg):
+                if msg["type"] in ("lifespan.startup.complete",
+                                   "lifespan.startup.failed"):
+                    started.set()
+
+            try:
+                await self.app(scope, receive, send)
+            except BaseException:  # noqa: BLE001 — app rejects lifespan
+                started.set()
+
+        import asyncio as _asyncio
+
+        _asyncio.run_coroutine_threadsafe(lifespan(), self.loop)
+        started.wait(timeout=30)
+        self._state = state
+
+    def _loop_main(self):
+        import asyncio
+
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def handle(self, request: dict) -> dict:
+        import asyncio
+
+        body = request.get("body", b"")
+        incoming = [{"type": "http.request", "body": body,
+                     "more_body": False}]
+        out = {"status": 500, "headers": [], "body": b""}
+
+        async def receive():
+            if incoming:
+                return incoming.pop(0)
+            return {"type": "http.disconnect"}
+
+        async def send(msg):
+            if msg["type"] == "http.response.start":
+                out["status"] = int(msg["status"])
+                out["headers"] = [
+                    (bytes(k).decode("latin1"), bytes(v).decode("latin1"))
+                    for k, v in msg.get("headers", [])]
+            elif msg["type"] == "http.response.body":
+                out["body"] = out["body"] + bytes(msg.get("body", b""))
+
+        scope = {
+            "type": "http", "asgi": {"version": "3.0"},
+            "http_version": "1.1",
+            "method": request.get("method", "GET"),
+            "path": request.get("path", "/"),
+            "raw_path": request.get("path", "/").encode(),
+            "query_string": request.get("query_string", b""),
+            "headers": [(k.lower().encode("latin1"), v.encode("latin1"))
+                        for k, v in request.get("headers", [])],
+            "client": None, "server": None, "scheme": "http",
+            "state": dict(self._state),
+        }
+        fut = asyncio.run_coroutine_threadsafe(
+            self.app(scope, receive, send), self.loop)
+        fut.result(timeout=30)
+        return out
 
 
 # --------------------------------------------------- decorator local state

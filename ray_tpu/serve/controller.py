@@ -220,6 +220,12 @@ class ServeController:
             if info:
                 info.request_count += 1
 
+    def is_ingress(self, name: str) -> bool:
+        with self._lock:
+            info = self._deployments.get(name)
+        return bool(info is not None
+                    and getattr(info.cls, "__serve_ingress__", None))
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             return {

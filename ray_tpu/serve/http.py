@@ -22,10 +22,11 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _route(self):
-        from urllib.parse import parse_qs, urlparse
+        from urllib.parse import parse_qs, unquote, urlparse
 
         parsed = urlparse(self.path)
-        name = parsed.path.strip("/").split("/")[0]
+        segments = parsed.path.strip("/").split("/")
+        name = segments[0]
         if not name:
             self.send_response(404)
             self.end_headers()
@@ -33,11 +34,47 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0) or 0)
         body = self.rfile.read(length) if length else b""
+        controller = get_or_create_controller()
+        if controller.is_ingress(name):
+            # ASGI ingress: /<deployment>/<subpath> drives the bound app
+            # with path=/<subpath> inside the replica.
+            # ASGI-3: scope path is percent-DECODED; trailing slashes
+            # are routing-significant and must survive.
+            sub_path = "/" + "/".join(unquote(s) for s in segments[1:])
+            if parsed.path.endswith("/") and sub_path != "/":
+                sub_path += "/"
+            request = {
+                "method": self.command,
+                "path": sub_path,
+                "query_string": (parsed.query or "").encode(),
+                "headers": list(self.headers.items()),
+                "body": body,
+            }
+            try:
+                handle = DeploymentHandle(name, controller)
+                out = handle.options("__serve_asgi__").remote(
+                    request).result(timeout=30)
+                self.send_response(int(out.get("status", 200)))
+                payload = out.get("body", b"")
+                for k, v in out.get("headers", []):
+                    if k.lower() not in ("content-length",
+                                         "transfer-encoding"):
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            except Exception as exc:  # noqa: BLE001 — request boundary
+                payload = json.dumps({"error": repr(exc)}).encode()
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            return
         stream = parse_qs(parsed.query).get(
             "stream", ["0"])[0] in ("1", "true")
         try:
             arg = json.loads(body) if body else None
-            handle = DeploymentHandle(name, get_or_create_controller())
+            handle = DeploymentHandle(name, controller)
             if stream:
                 # Chunked transfer: one JSON line per generator item, sent
                 # as the replica yields (reference: streaming responses
@@ -83,6 +120,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     do_GET = _route
     do_POST = _route
+    do_PUT = _route
+    do_DELETE = _route
+    do_PATCH = _route
+    do_HEAD = _route
+    do_OPTIONS = _route
 
 
 class HTTPProxy:
