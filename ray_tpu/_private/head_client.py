@@ -51,6 +51,14 @@ class Subscription:
         return self._queue.get(timeout=timeout)
 
     def close(self):
+        try:
+            self._object_server.shutdown()
+            self._peers.close()
+        except Exception:  # noqa: BLE001 — already down
+            pass
+        return self._close_impl()
+
+    def _close_impl(self):
         self._client.unsubscribe(self.topic, self._handler)
 
 
@@ -81,6 +89,21 @@ class HeadClient:
         self._pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu_head_event")
         self._serialized_cache: Dict[bytes, bytes] = {}  # chunked reads
+        # Direct data plane (ObjectManager role): serve local objects to
+        # peers; pull remote objects peer-to-peer when the head knows the
+        # owner's address, falling back to head-relayed chunks.
+        from ray_tpu._private.object_server import (
+            ObjectServer,
+            PeerPool,
+            local_ip_toward,
+        )
+
+        self._object_server = ObjectServer(
+            self._serialized_bytes, self.token,
+            advertise_host=local_ip_toward(self._req._sock))
+        self._peers = PeerPool(self.token)
+        self.direct_pulls = 0
+        self.relayed_pulls = 0
         self._event_thread = threading.Thread(
             target=self._event_loop, daemon=True,
             name="ray_tpu_head_events")
@@ -162,9 +185,19 @@ class HeadClient:
         return self._request(("object_announce", oid_bin))
 
     def object_pull(self, oid_bin: bytes) -> Optional[bytes]:
-        """Pull a remote object's serialized bytes in bounded chunks
-        (ObjectManager chunked-transfer analogue). Returns None when no
-        live owner is known."""
+        """Pull a remote object's serialized bytes: direct peer-to-peer
+        from the owner's object server when the head knows its address
+        (the ObjectManager data plane — head out of the data path),
+        head-relayed bounded chunks otherwise."""
+        located = self._request(("object_locate", oid_bin))
+        if located and located.get("addr"):
+            raw = self._peers.pull(tuple(located["addr"]), oid_bin)
+            if raw is not None:
+                self.direct_pulls += 1
+                return raw
+        return self._object_pull_relayed(oid_bin)
+
+    def _object_pull_relayed(self, oid_bin: bytes) -> Optional[bytes]:
         size = self._request(("object_meta", oid_bin))
         if size is None:
             return None
@@ -180,6 +213,7 @@ class HeadClient:
                 return None
             parts.append(chunk)
             offset += len(chunk)
+        self.relayed_pulls += 1
         return b"".join(parts)
 
     # --------------------------------------------------------------- nodes
@@ -371,10 +405,11 @@ class HeadClient:
                     status = None
             with self._subs_lock:
                 topics = list(self._subs)
+            status = dict(status or {})
             if topics:
-                status = dict(status or {})
                 status["_subs"] = topics
-            msg = ("heartbeat", status) if status else ("heartbeat",)
+            status["_peer_addr"] = list(self._object_server.address)
+            msg = ("heartbeat", status)
             try:
                 with self._hb_lock:
                     self._hb.send(msg)

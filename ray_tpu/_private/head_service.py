@@ -147,6 +147,7 @@ class _Client:
         self.resources: Dict[str, float] = {}
         self.status: Dict[str, Any] = {}  # last heartbeat load report
         self.subs: set = set()  # pub/sub topics (re-asserted by heartbeat)
+        self.peer_addr = None   # direct object-server (host, port)
 
 
 class _StateLog:
@@ -332,6 +333,9 @@ class HeadService:
                         subs = msg[1].get("_subs")
                         if subs is not None:
                             c.subs = set(subs)
+                        addr = msg[1].get("_peer_addr")
+                        if addr is not None:
+                            c.peer_addr = (str(addr[0]), int(addr[1]))
                 return ("ok", None)
             if kind == "subscribe":
                 with self._lock:
@@ -414,6 +418,19 @@ class HeadService:
                     return ("ok", None)
                 return self._relay(owner, ("object_get", oid_bin),
                                    timeout=60.0)
+            if kind == "object_locate":
+                # Location service for the direct data plane: who owns
+                # it, and where their object server listens. The bytes
+                # then move peer-to-peer, not through this process.
+                _, oid_bin = msg
+                owner = self._object_owner(oid_bin)
+                if owner is None:
+                    return ("ok", None)
+                with self._lock:
+                    c2 = self._clients.get(owner)
+                    addr = c2.peer_addr if c2 is not None else None
+                return ("ok", {"owner": owner,
+                               "addr": list(addr) if addr else None})
             if kind == "object_meta":
                 _, oid_bin = msg
                 owner = self._object_owner(oid_bin)

@@ -1,0 +1,155 @@
+"""Direct node-to-node object data plane (reference role: the
+ObjectManager / object-store peer transfer protocol — raylets pull
+object chunks straight from the owning node, the GCS only resolves
+locations [unverified]).
+
+Every head-attached client runs one of these: a TokenListener (same
+framed-msgpack + HMAC transport as the control plane, same per-cluster
+token) serving ``meta``/``chunk`` reads from the local object provider.
+Pullers resolve the owner's direct address through the head
+(``object_locate``) and move the bytes peer-to-peer; the head-relayed
+pull remains the fallback when a peer is unreachable (NAT, dead server),
+so the control plane never sits in the data path unless it has to.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.transport import (
+    FramedConnection,
+    TokenListener,
+    connect,
+)
+
+PULL_CHUNK = 4 << 20
+
+
+class ObjectServer:
+    """Serves this process's objects to authenticated peers."""
+
+    def __init__(self, bytes_provider: Callable[[bytes], bytes],
+                 token: str, advertise_host: str = "127.0.0.1"):
+        self._provider = bytes_provider
+        self._listener = TokenListener("0.0.0.0", 0, token)
+        self.address: Tuple[str, int] = (
+            advertise_host, self._listener.address[1])
+        self._stop = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="ray_tpu_object_server")
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept_raw()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="ray_tpu_object_peer").start()
+
+    def _serve_conn(self, conn: FramedConnection):
+        try:
+            self._listener.server_handshake(conn)
+        except Exception:  # noqa: BLE001 — unauthenticated peer
+            conn.close()
+            return
+        try:
+            while not self._stop:
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == "meta":
+                    try:
+                        raw = self._provider(bytes(msg[1]))
+                        conn.send(("ok", len(raw)))
+                    except Exception:  # noqa: BLE001 — not owned here
+                        conn.send(("ok", None))
+                elif kind == "chunk":
+                    _, oid, offset, length = msg
+                    try:
+                        raw = self._provider(bytes(oid))
+                        conn.send(("ok", raw[offset:offset + length]))
+                    except Exception:  # noqa: BLE001
+                        conn.send(("ok", None))
+                else:
+                    conn.send(("err", f"unknown request {kind!r}"))
+        except (EOFError, OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop = True
+        self._listener.close()
+
+
+class PeerPool:
+    """Cached authenticated connections to peer object servers; one
+    in-flight request per peer (requests are serial per connection)."""
+
+    def __init__(self, token: str):
+        self._token = token
+        self._conns: Dict[Tuple[str, int], FramedConnection] = {}
+        self._locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, addr: Tuple[str, int]):
+        with self._lock:
+            conn = self._conns.get(addr)
+            lock = self._locks.setdefault(addr, threading.Lock())
+        if conn is None:
+            conn = connect(addr[0], addr[1], self._token, timeout=5.0)
+            with self._lock:
+                self._conns[addr] = conn
+        return conn, lock
+
+    def _drop(self, addr: Tuple[str, int]):
+        with self._lock:
+            conn = self._conns.pop(addr, None)
+        if conn is not None:
+            conn.close()
+
+    def pull(self, addr: Tuple[str, int],
+             oid_bin: bytes) -> Optional[bytes]:
+        """Direct chunked pull; None on any failure (caller falls back to
+        the head-relayed path)."""
+        try:
+            conn, lock = self._get(addr)
+            with lock:
+                conn.send(("meta", oid_bin))
+                status, size = conn.recv()
+                if status != "ok" or size is None:
+                    return None
+                parts = []
+                offset = 0
+                while offset < size:
+                    length = min(PULL_CHUNK, size - offset)
+                    conn.send(("chunk", oid_bin, offset, length))
+                    status, chunk = conn.recv()
+                    if status != "ok" or not chunk:
+                        return None
+                    parts.append(chunk)
+                    offset += len(chunk)
+                return b"".join(parts)
+        except Exception:  # noqa: BLE001 — peer gone / handshake failed
+            self._drop(addr)
+            return None
+
+    def close(self):
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for conn in conns.values():
+            conn.close()
+
+
+def local_ip_toward(sock: socket.socket) -> str:
+    """The local address this socket uses — the IP peers on the same
+    network can dial back."""
+    try:
+        return sock.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

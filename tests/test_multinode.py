@@ -259,3 +259,41 @@ def test_ray_client_mode_without_nodes_errors(tmp_path):
         ray_tpu.shutdown()
         head.kill()
         head.wait(timeout=5)
+
+
+def test_direct_peer_object_pull(two_node_cluster):
+    """Object bytes move peer-to-peer through the owner's object server
+    (the ObjectManager data plane); the head only resolves the location."""
+
+    @ray_tpu.remote(resources={"n1": 0.1})
+    def make():
+        return {"blob": list(range(50_000))}
+
+    ref = make.remote()
+    out = ray_tpu.get(ref, timeout=60)
+    assert out["blob"][-1] == 49_999
+    w = ray_tpu._private.worker.global_worker()
+    assert w.head_client.direct_pulls > 0, (
+        w.head_client.direct_pulls, w.head_client.relayed_pulls)
+
+
+def test_peer_pull_falls_back_to_relay(two_node_cluster):
+    """A dead/unreachable peer address degrades to the head-relayed
+    chunked pull instead of failing the get."""
+    w = ray_tpu._private.worker.global_worker()
+
+    @ray_tpu.remote(resources={"n2": 0.1})
+    def make():
+        return "via-relay"
+
+    ref = make.remote()
+    # Poison the peer pool: any direct dial fails instantly, so the pull
+    # must take the relay path.
+    orig = w.head_client._peers.pull
+    w.head_client._peers.pull = lambda addr, oid: None
+    try:
+        before = w.head_client.relayed_pulls
+        assert ray_tpu.get(ref, timeout=60) == "via-relay"
+        assert w.head_client.relayed_pulls > before
+    finally:
+        w.head_client._peers.pull = orig
