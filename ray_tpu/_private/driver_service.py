@@ -99,7 +99,11 @@ def handle_request(worker, shm_store, state: _ServiceState, msg: tuple):
         return ("ok", None)
     if kind == "api_actor_submit":
         _, actor_bin, method_name, args_bytes, num_returns, name = msg
-        runtime = worker.actors.get(ActorID(actor_bin))
+        # The handle may point at a cluster-placed actor hosted on some
+        # other node: borrow through the placement directory.
+        from ray_tpu._private.remote_actor import resolve_or_borrow
+
+        runtime = resolve_or_borrow(worker, ActorID(actor_bin))
         if runtime is None:
             raise ValueError("actor not found on the driver")
         args, kwargs = cloudpickle.loads(args_bytes)

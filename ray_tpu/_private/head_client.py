@@ -51,14 +51,6 @@ class Subscription:
         return self._queue.get(timeout=timeout)
 
     def close(self):
-        try:
-            self._object_server.shutdown()
-            self._peers.close()
-        except Exception:  # noqa: BLE001 — already down
-            pass
-        return self._close_impl()
-
-    def _close_impl(self):
         self._client.unsubscribe(self.topic, self._handler)
 
 
@@ -89,6 +81,10 @@ class HeadClient:
         self._pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu_head_event")
         self._serialized_cache: Dict[bytes, bytes] = {}  # chunked reads
+        # Relayed-call results pinned until pulled (bounded FIFO).
+        from collections import OrderedDict
+
+        self._pinned_results: "OrderedDict[bytes, Any]" = OrderedDict()
         # Direct data plane (ObjectManager role): serve local objects to
         # peers; pull remote objects peer-to-peer when the head knows the
         # owner's address, falling back to head-relayed chunks.
@@ -175,10 +171,34 @@ class HeadClient:
 
     def actor_call(self, owner_id: str, actor_bin: bytes, method: str,
                    args, kwargs, num_returns: int):
-        value = self._request((
+        """Relay an actor method call to its owning driver. Returns the
+        result OBJECT IDS (announced by the owner) — the caller pulls the
+        bytes peer-to-peer, so large results never ride the relay."""
+        oid_bins = self._request((
             "actor_call", owner_id, actor_bin, method,
             pickle.dumps((args, kwargs), protocol=5), num_returns))
-        return pickle.loads(value)  # serialized results (or raises)
+        return [bytes(b) for b in oid_bins]
+
+    # ----------------------------------------------- cluster actor placement
+    def actor_place(self, actor_bin: bytes, record: dict):
+        return self._request(("actor_place", actor_bin, dict(record)))
+
+    def actor_unplace(self, actor_bin: bytes):
+        return self._request(("actor_unplace", actor_bin))
+
+    def actor_locate(self, actor_bin: bytes):
+        rec = self._request(("actor_locate", actor_bin))
+        return dict(rec) if rec is not None else None
+
+    def actor_push(self, target_client: str, payload: bytes):
+        """Head-relayed actor op (create/submit/kill) for nodes whose
+        direct server this driver cannot dial."""
+        return self._request(("actor_push", target_client, payload))
+
+    def node_call(self, addr, msg: tuple):
+        """Direct request against a node's server (actor plane). Raises
+        on transport failure so callers can fall back to actor_push."""
+        return self._peers.call(tuple(addr), msg)
 
     # ------------------------------------------------------------- objects
     def object_announce(self, oid_bin: bytes):
@@ -256,6 +276,15 @@ class HeadClient:
             if msg[0] != "req":
                 continue
             rid, event = msg[1], msg[2:]
+            if event and event[0] == "actor_call":
+                # Relayed actor calls wait unbounded for method completion
+                # (long-running methods are legitimate) — they get their
+                # OWN thread so they can never starve the 4-thread pool
+                # that serves object reads / task pushes / pubsub.
+                threading.Thread(
+                    target=self._serve_event, args=(rid, event),
+                    daemon=True, name="ray_tpu_head_actor_call").start()
+                continue
             self._pool.submit(self._serve_event, rid, event)
 
     def _reconnect_event(self) -> bool:
@@ -296,6 +325,22 @@ class HeadClient:
             # so the caller is NOT left hanging; our event loop re-dials.
             pass
 
+    def _pin_result(self, ref):
+        """Keep a relayed-call result alive until the caller pulls it.
+        Time-based release (callers pull promptly after the reply) with
+        a count cap as the memory backstop — a FIFO-only cap could drop
+        a result a slow caller has not fetched yet."""
+        import time as _time
+
+        now = _time.monotonic()
+        self._pinned_results[ref.object_id.binary()] = (ref, now)
+        while self._pinned_results:
+            _, (_, ts) = next(iter(self._pinned_results.items()))
+            if now - ts > 600.0 or len(self._pinned_results) > 4096:
+                self._pinned_results.popitem(last=False)
+            else:
+                break
+
     def _serialized_bytes(self, oid_bin: bytes) -> bytes:
         """Serialized form of a locally-owned object, cached briefly so a
         chunked pull doesn't re-serialize per chunk."""
@@ -335,12 +380,17 @@ class HeadClient:
             args, kwargs = pickle.loads(args_bytes)
             refs = runtime.submit(method, args, kwargs, num_returns,
                                   method)
-            # Resolve results locally; cross-driver handles get VALUES
-            # back (one round trip), not refs into a foreign store.
-            import ray_tpu
-
-            values = [ray_tpu.get(r, timeout=60.0) for r in refs]
-            return pickle.dumps(values, protocol=5)
+            # Results stay OFF the relay: wait for completion (unbounded —
+            # long-running methods are legitimate), announce the ids, and
+            # reply with the ids; the caller pulls the bytes p2p from our
+            # object server. Pin the refs so the store keeps the bytes
+            # until the caller has fetched them.
+            w.store.wait([r.object_id for r in refs], len(refs),
+                         timeout=None)
+            for r in refs:
+                self.object_announce(r.object_id.binary())
+                self._pin_result(r)
+            return [r.object_id.binary() for r in refs]
         if kind == "object_get":
             return self._serialized_bytes(event[1])
         if kind == "object_meta":
@@ -428,6 +478,16 @@ class HeadClient:
     def close(self):
         self._stop.set()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        # The direct data plane must die with the client or its listener
+        # port and peer sockets leak (one pair per init/shutdown cycle).
+        try:
+            self._object_server.shutdown()
+        except Exception:  # noqa: BLE001 — already down
+            pass
+        try:
+            self._peers.close()
+        except Exception:  # noqa: BLE001
+            pass
         for conn in (self._req, self._event, self._hb):
             try:
                 conn.close()

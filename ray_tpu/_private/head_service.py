@@ -156,6 +156,12 @@ class _StateLog:
     Records are length-prefixed msgpack tuples. Replay stops at the first
     torn record (crash mid-write), which is safe: the log is replayed
     before serving, so the lost tail is at most the final in-flight op.
+
+    Unbounded growth is handled by snapshot compaction: past a record
+    threshold the head serializes its full state as one ``snapshot``
+    record into a fresh file and atomically renames it over the log
+    (``rewrite``), so a long-lived cluster's log stays proportional to
+    its live state, not its history.
     """
 
     _LEN = struct.Struct(">I")
@@ -165,12 +171,30 @@ class _StateLog:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
         self._lock = threading.Lock()
+        self.appended = 0  # records since open/compaction
 
     def append(self, record: tuple):
         data = pack(record)
         with self._lock:
             self._f.write(self._LEN.pack(len(data)) + data)
             self._f.flush()
+            self.appended += 1
+
+    def rewrite(self, snapshot: tuple):
+        """Replace the log with a single snapshot record (compaction).
+        Crash-safe: the snapshot is written to a temp file and renamed
+        over the log only once fully flushed."""
+        data = pack(snapshot)
+        tmp = self.path + ".compact"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(self._LEN.pack(len(data)) + data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._f.close()
+            self._f = open(self.path, "ab")
+            self.appended = 0
 
     @staticmethod
     def replay(path: str):
@@ -221,6 +245,13 @@ class HeadService:
         # name -> (client_id, actor_id_bin, class_name)
         self._actors: Dict[Tuple[str, str], Tuple[str, bytes, str]] = {}
         self._objects: Dict[bytes, str] = {}  # oid_bin -> owner client
+        # Cluster actor placement (GcsActorManager role): actor_id ->
+        # {"node": hosting client, "driver": owning client, "cls": bytes,
+        #  "class_name": str, "detached": bool}.
+        self._places: Dict[bytes, dict] = {}
+        self._compact_threshold = int(os.environ.get(
+            "RAY_TPU_HEAD_LOG_COMPACT_RECORDS", "50000"))
+        self._compact_pending = False
         self._log: Optional[_StateLog] = None
         if state_path:
             self._restore(state_path)
@@ -238,6 +269,30 @@ class HeadService:
         through the normal monitor path and their entries GC."""
         for rec in _StateLog.replay(state_path):
             op = rec[0]
+            if op == "snapshot":
+                # Full-state record from compaction: replaces everything
+                # replayed so far (it IS the log's prefix after rewrite).
+                _, kv, actors, objects, nodes, places = rec
+                self._kv = {bytes(k): bytes(v) for k, v in kv}
+                self._actors = {
+                    (ns, name): (cid, bytes(abin), cls)
+                    for ns, name, cid, abin, cls in actors}
+                self._objects = {bytes(o): cid for o, cid in objects}
+                self._places = {bytes(a): dict(r) for a, r in places}
+                for cid in set(self._objects.values()) | {
+                        v[0] for v in self._actors.values()}:
+                    self._clients.setdefault(cid, _Client(cid))
+                for cid, node_id, resources in nodes:
+                    c = self._clients.setdefault(cid, _Client(cid))
+                    c.is_node, c.node_id = True, node_id
+                    c.resources = dict(resources)
+                continue
+            if op == "actor_place":
+                self._places[bytes(rec[1])] = dict(rec[2])
+                continue
+            if op == "actor_unplace":
+                self._places.pop(bytes(rec[1]), None)
+                continue
             if op == "kv_put":
                 self._kv[rec[1]] = rec[2]
             elif op == "kv_del":
@@ -263,8 +318,34 @@ class HeadService:
         if self._log is not None:
             try:
                 self._log.append(record)
+                if self._log.appended >= self._compact_threshold:
+                    # Compaction runs on the MONITOR thread, never inline:
+                    # some persist sites hold self._lock, and _compact
+                    # needs it (non-reentrant) for a consistent snapshot.
+                    self._compact_pending = True
             except Exception:  # noqa: BLE001 — disk full: serve from memory
                 pass
+
+    def _compact(self):
+        """Rewrite the append-log as one snapshot of current state.
+
+        Snapshot build AND rewrite happen under self._lock: every state
+        mutation also holds it, so any record a handler appends after we
+        release is for a mutation the snapshot already contains — replay
+        of snapshot + duplicate record is idempotent, and no mutation
+        can fall between the snapshot and the rewrite."""
+        with self._lock:
+            snapshot = (
+                "snapshot",
+                [(k, v) for k, v in self._kv.items()],
+                [(ns, name, cid, abin, cls)
+                 for (ns, name), (cid, abin, cls) in self._actors.items()],
+                [(o, cid) for o, cid in self._objects.items()],
+                [(c.client_id, c.node_id, c.resources)
+                 for c in self._clients.values() if c.is_node],
+                [(a, r) for a, r in self._places.items()],
+            )
+            self._log.rewrite(snapshot)
 
     # ------------------------------------------------------------- serving
     def serve_forever(self):
@@ -386,9 +467,11 @@ class HeadService:
                 _, namespace, name = msg
                 with self._lock:
                     entry = self._actors.get((namespace, name))
-                    if entry is not None and entry[0] == client_id:
+                    removed = entry is not None and entry[0] == client_id
+                    if removed:
                         del self._actors[(namespace, name)]
-                        self._persist("actor_deregister", namespace, name)
+                if removed:  # persist OUTSIDE the lock (compaction path)
+                    self._persist("actor_deregister", namespace, name)
                 return ("ok", None)
             if kind == "actor_lookup":
                 _, namespace, name = msg
@@ -403,6 +486,39 @@ class HeadService:
                 return self._relay(owner_id, (
                     "actor_call", actor_bin, method, args_bytes,
                     num_returns))
+            if kind == "actor_place":
+                # Record where a cluster actor lives (GcsActorManager
+                # placement directory). The placing driver owns the
+                # record; the hosting node serves the calls.
+                _, actor_bin, record = msg
+                with self._lock:
+                    self._places[actor_bin] = dict(record)
+                self._persist("actor_place", actor_bin, dict(record))
+                return ("ok", None)
+            if kind == "actor_unplace":
+                with self._lock:
+                    existed = self._places.pop(msg[1], None) is not None
+                if existed:
+                    self._persist("actor_unplace", msg[1])
+                return ("ok", existed)
+            if kind == "actor_locate":
+                _, actor_bin = msg
+                with self._lock:
+                    rec = self._places.get(actor_bin)
+                    if rec is None:
+                        return ("ok", None)
+                    node = self._clients.get(rec.get("node"))
+                    alive = node is not None and node.alive
+                    addr = node.peer_addr if node is not None else None
+                return ("ok", dict(rec, alive=alive,
+                                   addr=list(addr) if addr else None))
+            if kind == "actor_push":
+                # Control-plane fallback for actor ops when the driver
+                # cannot dial the node's direct server (NAT): relay over
+                # the node's event channel like task_push.
+                _, target_client, payload = msg
+                return self._relay(target_client, ("actor_push", payload),
+                                   timeout=60.0)
             if kind == "object_announce":
                 with self._lock:
                     self._objects[msg[1]] = client_id
@@ -534,6 +650,12 @@ class HeadService:
     def _monitor_loop(self):
         timeout_s = _client_timeout_s()
         while not self._stop.wait(_HEARTBEAT_PERIOD_S):
+            if self._compact_pending and self._log is not None:
+                self._compact_pending = False
+                try:
+                    self._compact()
+                except Exception:  # noqa: BLE001 — disk trouble: keep log
+                    pass
             now = time.monotonic()
             newly_dead = []
             with self._lock:
@@ -552,6 +674,15 @@ class HeadService:
                                    if owner in dead]
                 for oid in dropped_objects:
                     del self._objects[oid]
+                # Placement records die with their hosting node (the
+                # owning driver re-places survivors) or with their owning
+                # driver (unless detached).
+                dropped_places = [
+                    a for a, r in self._places.items()
+                    if r.get("node") in dead
+                    or (r.get("driver") in dead and not r.get("detached"))]
+                for a in dropped_places:
+                    del self._places[a]
                 # Prune long-dead clients entirely (a long-lived head
                 # serving churning drivers must not grow without bound).
                 for cid in [cid for cid, c in self._clients.items()
@@ -566,6 +697,8 @@ class HeadService:
                             pass
             for ns, name in dropped_actors:
                 self._persist("actor_deregister", ns, name)
+            for a in dropped_places:
+                self._persist("actor_unplace", a)
             for oid in dropped_objects:
                 self._persist("object_forget", oid)
             for cid, node_id in newly_dead:

@@ -50,14 +50,22 @@ class NodeDaemon:
         self.head = self.worker.head_client
         self.head.handlers["task_push"] = self._on_task_push
         self.head.status_fn = self._status
+        # Cluster actor plane: host actors placed here by remote drivers
+        # (direct actor_op requests + head-relayed actor_push fallback).
+        from ray_tpu._private.remote_actor import ActorHost
+
+        self.actor_host = ActorHost(self.worker, self.head)
         self.head.node_register(
             self.worker.node_id.hex(), self.worker.resource_pool.total)
         self._stop = threading.Event()
 
     def _status(self) -> dict:
+        hosted = sum(1 for a in self.worker.actors.values()
+                     if not getattr(a, "borrower", False))
         return {
             "backlog": self.worker.scheduler.backlog_size(),
             "available": self.worker.resource_pool.available(),
+            "actors": hosted,  # borrowed handles are not load
         }
 
     # ----------------------------------------------------------- task serve
@@ -141,6 +149,7 @@ class NodeDaemon:
         import ray_tpu
 
         self._stop.set()
+        self.actor_host.shutdown()
         ray_tpu.shutdown()
 
 

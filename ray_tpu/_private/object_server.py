@@ -22,17 +22,34 @@ from ray_tpu._private.transport import (
     FramedConnection,
     TokenListener,
     connect,
+    exc_to_wire,
+    wire_to_exc,
 )
 
 PULL_CHUNK = 4 << 20
 
 
+class PeerUnreachableError(ConnectionError):
+    """Transport-level failure dialing/talking to a peer server —
+    distinct from an error the peer's handler raised, so callers know
+    a head-relayed fallback is worth trying."""
+
+
 class ObjectServer:
-    """Serves this process's objects to authenticated peers."""
+    """Serves this process's objects to authenticated peers.
+
+    Also the node's direct request plane: arbitrary request kinds can be
+    registered via ``handlers`` (the actor host uses this for
+    create/submit/kill pushed straight from the calling driver — the
+    GcsActorScheduler's lease-on-node analogue, with the head only
+    resolving placement). Handlers run on the per-connection thread and
+    reply ``("ok", result)`` or ``("err", wire_error)``; they should
+    enqueue slow work and return fast."""
 
     def __init__(self, bytes_provider: Callable[[bytes], bytes],
                  token: str, advertise_host: str = "127.0.0.1"):
         self._provider = bytes_provider
+        self.handlers: Dict[str, Callable[[tuple], object]] = {}
         self._listener = TokenListener("0.0.0.0", 0, token)
         self.address: Tuple[str, int] = (
             advertise_host, self._listener.address[1])
@@ -75,8 +92,14 @@ class ObjectServer:
                         conn.send(("ok", raw[offset:offset + length]))
                     except Exception:  # noqa: BLE001
                         conn.send(("ok", None))
+                elif kind in self.handlers:
+                    try:
+                        conn.send(("ok", self.handlers[kind](msg)))
+                    except Exception as exc:  # noqa: BLE001 — handler error
+                        conn.send(("err", exc_to_wire(exc)))
                 else:
-                    conn.send(("err", f"unknown request {kind!r}"))
+                    conn.send(("err", exc_to_wire(
+                        ValueError(f"unknown request {kind!r}"))))
         except (EOFError, OSError, ValueError):
             pass
         finally:
@@ -138,6 +161,24 @@ class PeerPool:
         except Exception:  # noqa: BLE001 — peer gone / handshake failed
             self._drop(addr)
             return None
+
+    def call(self, addr: Tuple[str, int], msg: tuple):
+        """Direct request/response against a peer's registered handler.
+        Raises on transport failure (caller falls back to the head relay)
+        or re-raises the handler's wire error."""
+        try:
+            conn, lock = self._get(addr)
+            with lock:
+                conn.send(msg)
+                status, value = conn.recv()
+        except Exception as exc:
+            self._drop(addr)
+            raise PeerUnreachableError(
+                f"peer {addr[0]}:{addr[1]} unreachable: {exc}") from exc
+        if status == "err":
+            raise wire_to_exc(value) if isinstance(value, dict) else \
+                RuntimeError(str(value))
+        return value
 
     def close(self):
         with self._lock:

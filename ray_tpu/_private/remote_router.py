@@ -55,6 +55,14 @@ class RemoteRouter:
         self._inflight: Dict[str, int] = {}       # node client -> pushed
         self._oid_owner: Dict[bytes, str] = {}    # done oids -> node client
         self._failed: Dict[TaskID, BaseException] = {}
+        # Remote ACTOR tasks: completion tracked here (task_done +
+        # object pull), but never re-executed from lineage — interrupted
+        # actor calls fail (reference restart semantics); the
+        # RemoteActorRuntime's watcher materializes the errors.
+        self.external: Dict[TaskID, str] = {}     # tid -> node client_id
+        self.remote_actors: List = []             # RemoteActorRuntime watch
+        self._spread_counter = 0
+        self._placed_counts: Dict[str, int] = {}  # node -> actors placed
         self._recovering: set = set()
         self._prefetching: set = set()
         self._lock = threading.Lock()
@@ -110,6 +118,115 @@ class RemoteRouter:
         with self._lock:
             inflight = self._inflight.get(n["client_id"], 0)
         return (float(status.get("backlog", 0)) + inflight) / cpus
+
+    # ------------------------------------------------------ actor placement
+    @staticmethod
+    def actor_demand(opts: dict) -> Dict[str, float]:
+        """Resource demand of an actor from its options (num_cpus +
+        custom resources + PG bundle shape)."""
+        demand: Dict[str, float] = {}
+        if opts.get("num_cpus"):
+            demand["CPU"] = float(opts["num_cpus"])
+        strat = opts.get("scheduling_strategy")
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        if isinstance(strat, PlacementGroupSchedulingStrategy):
+            # PG-aware placement: the bundle's resource shape is the
+            # demand; the PG itself reserves per-node capacity only in
+            # the sim plane, so here bundles steer feasibility.
+            pg = strat.placement_group
+            idx = strat.placement_group_bundle_index
+            bundles = getattr(pg, "bundles", None) or []
+            if bundles:
+                bundle = bundles[max(idx, 0) % len(bundles)]
+                demand.update({k: float(v) for k, v in bundle.items()})
+        demand.update({k: float(v)
+                       for k, v in (opts.get("resources") or {}).items()})
+        return demand
+
+    def place_actor(self, opts: dict) -> Optional[dict]:
+        """Placement decision for a new actor (GcsActorScheduler role).
+        Returns the hosting node's membership record, or None for a
+        driver-local actor. Same policy family as maybe_route:
+
+        - ``NodeAffinitySchedulingStrategy`` pins to that node;
+        - a resource demand infeasible locally goes to a feasible node
+          (loud error when none exists);
+        - ``scheduling_strategy="SPREAD"`` round-robins over the local
+          runtime + all feasible nodes;
+        - thin clients (``ray://``) always place on the cluster;
+        - otherwise the actor stays local (driver-owned, zero latency).
+        """
+        demand = self.actor_demand(opts)
+        strat = opts.get("scheduling_strategy")
+        nodes = [n for n in self.nodes(refresh=True) if n.get("alive")]
+        client_mode = getattr(self.worker, "client_mode", False)
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            if strat.node_id == self.worker.node_id.hex() \
+                    and not client_mode:
+                return None
+            for n in nodes:
+                if n.get("node_id") == strat.node_id:
+                    return n
+            if not getattr(strat, "soft", False):
+                raise ValueError(
+                    f"no alive node {strat.node_id!r} for actor "
+                    f"NodeAffinity placement")
+        feasible = [n for n in nodes if self._fits(n, demand)]
+        local_fits = (self.worker.resource_pool.fits(demand)
+                      and not client_mode)
+        if not local_fits:
+            if not feasible:
+                raise ValueError(
+                    f"actor resource demand {demand} is infeasible: no "
+                    f"local capacity and no feasible cluster node")
+            return self._record_placement(
+                min(feasible, key=self._actor_load))
+        if strat == "SPREAD" and feasible:
+            # Round-robin across local + feasible nodes so replica/worker
+            # groups land on every machine.
+            with self._lock:
+                slot = self._spread_counter
+                self._spread_counter += 1
+            candidates: List[Optional[dict]] = [None] + feasible
+            return self._record_placement(
+                candidates[slot % len(candidates)])
+        return None
+
+    def _record_placement(self, node: Optional[dict]) -> Optional[dict]:
+        """Count placements locally so a burst placed between heartbeats
+        spreads instead of piling onto one node (same trick as the task
+        router's in-flight counter)."""
+        if node is not None:
+            with self._lock:
+                cid = node["client_id"]
+                self._placed_counts[cid] = \
+                    self._placed_counts.get(cid, 0) + 1
+        return node
+
+    def _actor_load(self, n: dict) -> float:
+        status = n.get("status") or {}
+        with self._lock:
+            placed = self._placed_counts.get(n["client_id"], 0)
+        # The heartbeat-reported count eventually includes our local
+        # placements; take the max so they are not double-counted.
+        return max(float(status.get("actors", 0)), float(placed)) \
+            + self._load(n)
+
+    def register_external(self, tid: TaskID, node_client: str):
+        """Track a remote actor task: completion arrives via task_done;
+        the result oids resolve through ensure_local like routed tasks."""
+        with self._lock:
+            self.external[tid] = node_client
+            self._done.setdefault(tid, threading.Event())
+
+    def watch_remote_actor(self, runtime):
+        """Register a RemoteActorRuntime for node-death watching (fail
+        in-flight calls + restart-on-surviving-node)."""
+        with self._lock:
+            self.remote_actors.append(runtime)
 
     def maybe_route(self, spec: TaskSpec) -> bool:
         """Called by Worker.submit_task before local submission. Returns
@@ -285,7 +402,8 @@ class RemoteRouter:
 
     def handles(self, object_id: ObjectID) -> bool:
         with self._lock:
-            return object_id.task_id() in self.lineage
+            tid = object_id.task_id()
+            return tid in self.lineage or tid in self.external
 
     def prefetch(self, object_id: ObjectID, timeout: float = 30.0):
         """Background ensure_local with in-flight dedup: wait() polls may
@@ -341,6 +459,15 @@ class RemoteRouter:
                     object_id, SerializedObject.from_bytes(raw))
                 return
             if ev is not None and ev.is_set():
+                with self._lock:
+                    external = tid in self.external
+                if external:
+                    # Actor-task result: never re-executed. The hosting
+                    # node may still be serializing — retry; if the node
+                    # died, the RemoteActorRuntime watcher materializes
+                    # an ActorDiedError into the store, ending this loop.
+                    time.sleep(0.05)
+                    continue
                 # Task finished but its owner cannot serve the bytes:
                 # the node died holding them. Re-execute from lineage.
                 self._reexecute(tid)
@@ -384,10 +511,21 @@ class RemoteRouter:
         while not self._stop.wait(0.5):
             with self._lock:
                 inflight = dict(self._task_node)
-            if not inflight:
+                actors = list(self.remote_actors)
+            if not inflight and not actors:
                 continue
             nodes = self.nodes(refresh=True)
             alive = {n["client_id"] for n in nodes if n.get("alive")}
+            for rt in actors:
+                try:
+                    rt.check_node(alive)
+                except Exception:  # noqa: BLE001 — keep the watcher alive
+                    pass
+            with self._lock:
+                self.remote_actors = [rt for rt in self.remote_actors
+                                      if not rt.dead]
+            if not inflight:
+                continue
             for tid, client_id in inflight.items():
                 if client_id in alive:
                     continue

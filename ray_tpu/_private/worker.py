@@ -462,6 +462,8 @@ class Worker:
         self.is_alive = False
         actors = list(self.actors.values())
         for actor in actors:
+            if getattr(actor, "borrower", False):
+                continue  # not ours to kill: the owning driver decides
             try:
                 actor.terminate(no_restart=True)
             except Exception:  # noqa: BLE001

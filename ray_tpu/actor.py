@@ -778,6 +778,14 @@ class _ActorRuntime:
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
+        return self.submit_prepared(method_name, args, kwargs, return_ids,
+                                    name)
+
+    def submit_prepared(self, method_name: str, args, kwargs,
+                        return_ids, name: str):
+        """Submit with caller-allocated return ids (the cluster actor
+        host uses this: the remote driver minted the ids)."""
+        worker = global_worker()
         for oid in return_ids:
             worker.store.mark_local_producer(oid)
         refs = [ObjectRef(oid) for oid in return_ids]
@@ -787,7 +795,8 @@ class _ActorRuntime:
             for oid in return_ids:
                 worker.store.put_error(oid, err)
             return refs
-        worker.task_events.record(task_id, "PENDING_ACTOR_TASK", name=name)
+        worker.task_events.record(return_ids[0].task_id(),
+                                  "PENDING_ACTOR_TASK", name=name)
         call = _MethodCall(method_name, args, kwargs, return_ids, name)
         with self._lock:
             self._mailbox.put(call)
@@ -984,8 +993,12 @@ class ActorHandle:
     def __getattr__(self, item: str):
         if item.startswith("_"):
             raise AttributeError(item)
-        method_opts = {}
-        fn = getattr(self._runtime.cls, item, None)
+        cls = self._runtime.cls
+        if cls is None:
+            # Borrowed cluster actor whose class is not importable here:
+            # method existence is validated by the hosting node instead.
+            return ActorMethod(self._runtime, item, {})
+        fn = getattr(cls, item, None)
         if fn is None:
             raise AttributeError(
                 f"actor {self._runtime.class_name!r} has no method {item!r}")
@@ -1008,7 +1021,12 @@ def _rebuild_handle(actor_id: ActorID) -> ActorHandle:
         # Handle crossed into a worker process: method calls go back
         # through the driver's API service.
         return ClientActorHandle(actor_id)
-    runtime = worker.actors.get(actor_id)
+    # A handle to a cluster-placed actor may have crossed onto this
+    # driver (pickled into a task pushed to another node, or resolved
+    # by name): borrow it — calls go direct to the hosting node.
+    from ray_tpu._private.remote_actor import resolve_or_borrow
+
+    runtime = resolve_or_borrow(worker, actor_id)
     if runtime is None:
         raise RayActorError(actor_id, "actor not found on this node")
     return ActorHandle(runtime)
@@ -1064,14 +1082,36 @@ class ActorClass:
             max_restarts = GlobalConfig.actor_max_restarts
         max_concurrency = opts.get("max_concurrency")
         try:
-            runtime = _ActorRuntime(
-                actor_id, self._cls, args, kwargs,
-                max_concurrency=max_concurrency,
-                max_restarts=max_restarts,
-                name=self._cls.__name__,
-                actor_name=actor_name,
-                runtime_target=opts.get("runtime"),
-            )
+            # Cluster placement: the router decides whether this actor
+            # lives locally or on a node daemon (resources / affinity /
+            # SPREAD / thin-client — GcsActorScheduler role). A remote
+            # placement builds a RemoteActorRuntime whose calls go
+            # direct-to-node.
+            node = None
+            if worker.remote_router is not None:
+                node = worker.remote_router.place_actor(opts)
+            if node is not None:
+                from ray_tpu._private.remote_actor import RemoteActorRuntime
+
+                runtime = RemoteActorRuntime(
+                    worker, actor_id, self._cls, args, kwargs,
+                    node=node,
+                    max_restarts=max_restarts,
+                    max_concurrency=max_concurrency,
+                    actor_name=actor_name,
+                    opts=opts,
+                    registered_name=(
+                        (namespace, actor_name) if actor_name else None),
+                )
+            else:
+                runtime = _ActorRuntime(
+                    actor_id, self._cls, args, kwargs,
+                    max_concurrency=max_concurrency,
+                    max_restarts=max_restarts,
+                    name=self._cls.__name__,
+                    actor_name=actor_name,
+                    runtime_target=opts.get("runtime"),
+                )
         except BaseException:
             if actor_name and worker.head_client is not None:
                 # Release the reserved cluster-wide name on construction
@@ -1115,8 +1155,16 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
         if entry is not None:
             owner_id, actor_bin, class_name = entry
             if owner_id != worker.head_client.client_id:
+                # Prefer the placement directory: a cluster-placed actor
+                # is callable direct-to-node from ANY driver, bypassing
+                # the owner-driver relay entirely.
+                from ray_tpu._private.remote_actor import resolve_or_borrow
+
+                runtime = resolve_or_borrow(worker, ActorID(bytes(actor_bin)))
+                if runtime is not None:
+                    return ActorHandle(runtime)
                 return CrossDriverActorHandle(
-                    owner_id, actor_bin, class_name)
+                    owner_id, bytes(actor_bin), class_name)
     raise ValueError(
         f"no live actor named {name!r} in namespace {ns!r}")
 
@@ -1156,11 +1204,20 @@ class _CrossDriverMethod:
 
         def _run():
             try:
-                values = worker.head_client.actor_call(
+                oid_bins = worker.head_client.actor_call(
                     h._owner_id, h._actor_bin, self._method, args, kwargs,
                     1)
-                worker.store.put(
-                    oid, worker.serialization_context.serialize(values[0]))
+                # The relay returned result IDS; the bytes move p2p from
+                # the owner's object server (head-relayed chunks as
+                # fallback) — large results never ride the event channel.
+                raw = worker.head_client.object_pull(oid_bins[0])
+                if raw is None:
+                    raise ActorDiedError(
+                        None, "cross-driver call result vanished before "
+                        "it could be pulled (owner died?)")
+                from ray_tpu._private.serialization import SerializedObject
+
+                worker.store.put(oid, SerializedObject.from_bytes(raw))
             except BaseException as exc:  # noqa: BLE001 — relay boundary
                 worker.store.put_error(oid, exc)
 

@@ -177,7 +177,11 @@ class ServeController:
 
         # Replicas serve concurrently (reference default: 100 ongoing
         # requests per replica) — required for @serve.batch to coalesce.
-        return Replica.options(max_concurrency=100).remote()
+        # SPREAD placement: with a cluster attached, replicas land across
+        # the node daemons (and the driver), so a deployment scales past
+        # one machine — a no-op standalone.
+        return Replica.options(max_concurrency=100,
+                               scheduling_strategy="SPREAD").remote()
 
     # ---------------------------------------------------------- autoscale
     def _autoscale(self):

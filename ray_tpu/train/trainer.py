@@ -135,7 +135,16 @@ class JaxTrainer:
                     _set_context(None)
                 return rank
 
-        workers = [TrainWorker.remote() for _ in range(n)]
+        # Cluster scaling: workers SPREAD across the driver + node
+        # daemons (no-op standalone); resources_per_worker steers
+        # feasibility — an infeasible-local demand forces every worker
+        # onto the cluster (one per node when capacity divides that way).
+        worker_opts: Dict[str, Any] = {"scheduling_strategy": "SPREAD"}
+        if self._scaling.resources_per_worker:
+            worker_opts["resources"] = dict(
+                self._scaling.resources_per_worker)
+        workers = [TrainWorker.options(**worker_opts).remote()
+                   for _ in range(n)]
         run_refs = [w.run.remote(i) for i, w in enumerate(workers)]
 
         # Drain rank-0 reports from the KV channel while the group runs

@@ -240,6 +240,81 @@ def test_head_restart_recovers(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_head_log_compaction(tmp_path):
+    """Past the record threshold the append-log collapses to one
+    snapshot record: the file stays proportional to LIVE state, and a
+    restart after compaction still serves the state."""
+    state = str(tmp_path / "state.log")
+    env = dict(os.environ)
+    env["RAY_TPU_HEAD_LOG_COMPACT_RECORDS"] = "50"
+
+    def spawn_head(port):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", str(port), "--state", state],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = proc.stdout.readline()
+        return proc, line.strip().rsplit(" ", 1)[-1]
+
+    ray_tpu.shutdown()
+    head1, address = spawn_head(0)
+    port = int(address.rsplit(":", 1)[1])
+    try:
+        worker = ray_tpu.init(num_cpus=1, worker_mode="thread",
+                              address=address, ignore_reinit_error=True)
+        # 600 writes over 20 live keys: without compaction the log holds
+        # 600 records; with it, at most threshold + snapshot.
+        for i in range(600):
+            worker.kv_put(f"c/{i % 20}".encode(), b"v" * 8)
+        assert worker.kv_get(b"c/7") == b"v" * 8
+        # Compaction runs on the head's monitor thread (0.5s tick).
+        uncompacted_estimate = 600 * 20  # ≥20B per kv_put record
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if os.path.getsize(state) < uncompacted_estimate / 2:
+                break
+            time.sleep(0.25)
+        assert os.path.getsize(state) < uncompacted_estimate / 2, (
+            os.path.getsize(state))
+        head1.kill()
+        head1.wait(timeout=5)
+        head2, _ = spawn_head(port)
+        try:
+            deadline = time.time() + 20
+            value = None
+            while time.time() < deadline:
+                try:
+                    value = worker.kv_get(b"c/13")
+                    if value is not None:
+                        break
+                except Exception:
+                    time.sleep(0.25)
+            assert value == b"v" * 8  # snapshot replayed
+        finally:
+            head2.kill()
+            head2.wait(timeout=5)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_head_client_close_frees_data_plane(head_proc):
+    """HeadClient.close() must shut down the direct object server and
+    peer pool — the listener port is released, not leaked."""
+    import socket
+
+    from ray_tpu._private.head_client import HeadClient
+
+    client = HeadClient(head_proc)
+    port = client._object_server._listener.address[1]
+    # Listener is live before close.
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.close()
+    client.close()
+    time.sleep(0.2)
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=1).close()
+
+
 _PUBSUB_PEER = r"""
 import sys, time
 import ray_tpu
