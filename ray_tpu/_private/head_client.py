@@ -253,6 +253,10 @@ class HeadClient:
     def cluster_info(self) -> dict:
         return dict(self._request(("cluster_info",)))
 
+    def demand_report(self):
+        """Every live client's heartbeat status (autoscaler input)."""
+        return [dict(c) for c in self._request(("demand_report",))]
+
     # -------------------------------------------------------------- events
     def _event_loop(self):
         """Serve relayed work from the head (the per-node agent role).

@@ -597,6 +597,15 @@ class HeadService:
                     self._persist("object_announce", ob, client_id)
                 return self._relay(driver_id, ("task_done", payload),
                                    timeout=30.0)
+            if kind == "demand_report":
+                # Autoscaler's view: every live client's heartbeat status
+                # (backlog, unmet resource shapes) + node resources.
+                with self._lock:
+                    return ("ok", [
+                        {"client_id": cl.client_id, "is_node": cl.is_node,
+                         "node_id": cl.node_id, "alive": cl.alive,
+                         "resources": cl.resources, "status": cl.status}
+                        for cl in self._clients.values() if cl.alive])
             if kind == "cluster_info":
                 with self._lock:
                     return ("ok", {

@@ -62,10 +62,12 @@ class NodeDaemon:
     def _status(self) -> dict:
         hosted = sum(1 for a in self.worker.actors.values()
                      if not getattr(a, "borrower", False))
+        router = self.worker.remote_router
         return {
             "backlog": self.worker.scheduler.backlog_size(),
             "available": self.worker.resource_pool.available(),
             "actors": hosted,  # borrowed handles are not load
+            "unmet": router.unmet_shapes() if router is not None else [],
         }
 
     # ----------------------------------------------------------- task serve
@@ -112,7 +114,8 @@ class NodeDaemon:
                 name=payload["name"],
                 resources=dict(payload["resources"]),
                 max_retries=payload["max_retries"],
-                retry_exceptions=payload["retry_exceptions"])
+                retry_exceptions=payload["retry_exceptions"],
+                runtime_env=payload.get("runtime_env"))
             self.worker.scheduler.submit(spec)
             # Wait for all outputs (errors also materialize as ready).
             self.worker.store.wait(return_ids, len(return_ids), timeout=None)

@@ -63,6 +63,15 @@ class RemoteRouter:
         self.remote_actors: List = []             # RemoteActorRuntime watch
         self._spread_counter = 0
         self._placed_counts: Dict[str, int] = {}  # node -> actors placed
+        # Demand surface for the autoscaler: tasks no current node (and
+        # no local capacity) can run are PARKED here until membership
+        # changes; their shapes ride the driver's heartbeat status so
+        # the autoscaler can provision nodes that fit (reference:
+        # resource_demand in the raylet's load report).
+        self._parked: List[TaskSpec] = []
+        self._unmet_hints: List[tuple] = []  # (shape, ts) — actor asks
+        if self.head.status_fn is None:
+            self.head.status_fn = self._status
         self._recovering: set = set()
         self._prefetching: set = set()
         self._lock = threading.Lock()
@@ -179,6 +188,12 @@ class RemoteRouter:
                       and not client_mode)
         if not local_fits:
             if not feasible:
+                # Record the shape so an autoscaler can provision for a
+                # retry, then fail loudly (actor creation is synchronous
+                # — it cannot park like a task).
+                with self._lock:
+                    self._unmet_hints.append((dict(demand),
+                                              time.monotonic()))
                 raise ValueError(
                     f"actor resource demand {demand} is infeasible: no "
                     f"local capacity and no feasible cluster node")
@@ -228,6 +243,38 @@ class RemoteRouter:
         with self._lock:
             self.remote_actors.append(runtime)
 
+    # --------------------------------------------------------- demand report
+    def unmet_shapes(self) -> List[Dict[str, float]]:
+        """Resource shapes this driver wants but no current node serves
+        (parked tasks + recent infeasible actor asks) — the autoscaler's
+        scale-up signal."""
+        now = time.monotonic()
+        with self._lock:
+            self._unmet_hints = [(s, ts) for s, ts in self._unmet_hints
+                                 if now - ts < 30.0]
+            return [dict(s.resources) for s in self._parked] + \
+                [dict(s) for s, _ in self._unmet_hints]
+
+    def _status(self) -> dict:
+        return {
+            "backlog": self.worker.scheduler.backlog_size(),
+            "unmet": self.unmet_shapes(),
+        }
+
+    def _retry_parked(self):
+        with self._lock:
+            parked, self._parked = self._parked, []
+        still = []
+        for spec in parked:
+            node = self._choose_node(spec)
+            if node is None:
+                still.append(spec)
+            else:
+                self._accept(spec, node)
+        if still:
+            with self._lock:
+                self._parked = still + self._parked
+
     def maybe_route(self, spec: TaskSpec) -> bool:
         """Called by Worker.submit_task before local submission. Returns
         True iff the task was taken over for remote execution."""
@@ -248,6 +295,21 @@ class RemoteRouter:
             return False
         node = self._choose_node(spec)
         if node is None:
+            hard_affinity = (isinstance(strat, NodeAffinitySchedulingStrategy)
+                            and not getattr(strat, "soft", False))
+            if not local_fits and not hard_affinity \
+                    and not getattr(self.worker, "client_mode", False):
+                # Infeasible EVERYWHERE: park it and advertise the shape
+                # so an autoscaler can provision a node that fits; the
+                # watch loop retries when membership changes. (Thin
+                # clients keep their loud no-capacity error; a hard
+                # NodeAffinity miss is a strategy miss, not a resource
+                # shape an autoscaler could satisfy — don't park it.)
+                with self._lock:
+                    self._parked.append(spec)
+                    self.lineage[spec.task_id] = spec
+                    self._done.setdefault(spec.task_id, threading.Event())
+                return True
             return False
         if not local_fits or affinity_remote or self._node_less_loaded(node):
             self._accept(spec, node)
@@ -322,6 +384,7 @@ class RemoteRouter:
             "resources": spec.resources,
             "max_retries": spec.max_retries,
             "retry_exceptions": spec.retry_exceptions,
+            "runtime_env": spec.runtime_env,
             "fn": cloudpickle.dumps(spec.function),
             "args": [_wire_arg(a) for a in spec.args],
             "kwargs": {k: _wire_arg(v) for k, v in spec.kwargs.items()},
@@ -510,8 +573,11 @@ class RemoteRouter:
         detection: membership comes from the head's heartbeat monitor)."""
         while not self._stop.wait(0.5):
             with self._lock:
+                parked = bool(self._parked)
                 inflight = dict(self._task_node)
                 actors = list(self.remote_actors)
+            if parked:
+                self._retry_parked()
             if not inflight and not actors:
                 continue
             nodes = self.nodes(refresh=True)

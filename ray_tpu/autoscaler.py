@@ -259,3 +259,293 @@ class AutoscalingCluster(Cluster):
         self._stop.set()
         self._monitor.join(timeout=2)
         super().shutdown()
+
+
+# ===================================================================== real
+class NodeProvider:
+    """Launches/terminates REAL cluster nodes (reference role:
+    autoscaler v1 NodeProvider — AWS/GCP/local implementations). A
+    provider returns an opaque handle per launched node; the autoscaler
+    owns lifecycle decisions, the provider owns mechanism."""
+
+    def launch(self, node_type: "NodeTypeConfig"):
+        raise NotImplementedError
+
+    def terminate(self, handle) -> None:
+        raise NotImplementedError
+
+    def poll_alive(self, handle) -> bool:
+        raise NotImplementedError
+
+
+class LocalSubprocessProvider(NodeProvider):
+    """Launches genuine ``node_daemon`` OS processes against a head —
+    the FakeMultiNodeProvider analogue, except the nodes are real: they
+    register with the head, lease tasks, host actors, and die by
+    SIGTERM (SURVEY §4 fake_multi_node; §2.7)."""
+
+    def __init__(self, address: str, worker_mode: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self.address = address
+        self.worker_mode = worker_mode
+        self.env = env
+
+    def launch(self, node_type: "NodeTypeConfig"):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        res = dict(node_type.resources)
+        cpus = int(res.pop("CPU", 1))
+        cmd = [sys.executable, "-m", "ray_tpu._private.node_daemon",
+               "--address", self.address, "--num-cpus", str(cpus),
+               "--resources", json.dumps(res)]
+        if self.worker_mode:
+            cmd += ["--worker-mode", self.worker_mode]
+        env = dict(self.env if self.env is not None else os.environ)
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                env=env)
+        # The daemon prints "... joined <addr> as <client_id>" once it
+        # has registered — capture the client id so the autoscaler can
+        # match this handle to head membership.
+        line = proc.stdout.readline()
+        if "joined" not in line:
+            proc.kill()
+            raise RuntimeError(
+                f"node daemon failed to join: {line!r}")
+        client_id = line.strip().rsplit(" ", 1)[-1]
+        return {"proc": proc, "client_id": client_id}
+
+    def terminate(self, handle) -> None:
+        proc = handle["proc"]
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except Exception:  # noqa: BLE001 — stubborn daemon
+            proc.kill()
+            proc.wait(timeout=5)
+
+    def poll_alive(self, handle) -> bool:
+        return handle["proc"].poll() is None
+
+
+@dataclass
+class _Managed:
+    type_name: str
+    handle: Any
+    client_id: str
+    idle_since: Optional[float] = None
+
+
+class ClusterAutoscaler:
+    """Demand-driven autoscaling of REAL nodes against a head service.
+
+    Watches head-observed demand — unmet resource shapes advertised in
+    client heartbeats (parked infeasible tasks, failed actor placements)
+    plus scheduler backlog beyond capacity — bin-packs the unmet shapes
+    onto configured node types, launches nodes through the provider,
+    and terminates nodes idle past the timeout (never below
+    ``min_workers``). Only nodes THIS autoscaler launched are ever
+    terminated. (Reference roles: StandardAutoscaler + monitor.py over
+    the GCS resource load; SURVEY §2.7.)
+    """
+
+    def __init__(self, address: str, node_types: List[NodeTypeConfig],
+                 provider: Optional[NodeProvider] = None,
+                 idle_timeout_s: float = 5.0,
+                 update_interval_s: float = 1.0):
+        from ray_tpu._private.head_client import HeadClient
+
+        self.node_types = {t.name: t for t in node_types}
+        self.provider = provider or LocalSubprocessProvider(address)
+        self.idle_timeout_s = idle_timeout_s
+        self._interval = update_interval_s
+        self._managed: List[_Managed] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.launched: List[str] = []
+        self.terminated: List[str] = []
+        import uuid
+
+        self.head = HeadClient(
+            address, client_id=f"autoscaler-{uuid.uuid4().hex[:8]}")
+        for t in node_types:
+            for _ in range(t.min_workers):
+                self._launch(t)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="ray_tpu_cluster_autoscaler")
+        self._monitor.start()
+
+    # --------------------------------------------------------------- sizing
+    def _counts(self) -> Dict[str, int]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for m in self._managed:
+                counts[m.type_name] = counts.get(m.type_name, 0) + 1
+        return counts
+
+    def num_nodes_of_type(self, name: str) -> int:
+        return self._counts().get(name, 0)
+
+    def _launch(self, t: NodeTypeConfig) -> bool:
+        if self._counts().get(t.name, 0) >= t.max_workers:
+            return False
+        try:
+            handle = self.provider.launch(t)
+        except Exception:  # noqa: BLE001 — provider failure: retry later
+            return False
+        client_id = handle.get("client_id", "") \
+            if isinstance(handle, dict) else ""
+        with self._lock:
+            self._managed.append(_Managed(t.name, handle, client_id))
+            self.launched.append(t.name)
+        return True
+
+    def _terminate(self, m: _Managed):
+        try:
+            self.provider.terminate(m.handle)
+        except Exception:  # noqa: BLE001 — already gone
+            pass
+        with self._lock:
+            if m in self._managed:
+                self._managed.remove(m)
+            self.terminated.append(m.type_name)
+
+    # --------------------------------------------------------------- demand
+    def _observe(self):
+        """(unmet shapes, per-node report by client_id) from the head."""
+        report = self.head.demand_report()
+        shapes: List[Dict[str, float]] = []
+        nodes: Dict[str, dict] = {}
+        backlog_pressure = 0
+        for c in report:
+            status = c.get("status") or {}
+            for s in status.get("unmet") or ():
+                shapes.append({k: float(v) for k, v in dict(s).items()})
+            if c.get("is_node"):
+                nodes[c["client_id"]] = c
+                cpus = max((c.get("resources") or {}).get("CPU", 1.0), 1.0)
+                backlog_pressure += max(
+                    int(status.get("backlog", 0)) - int(cpus), 0)
+        return shapes, nodes, backlog_pressure
+
+    def _bin_pack(self, shapes: List[Dict[str, float]],
+                  capacity: List[Dict[str, float]]):
+        """First-fit shapes against existing capacity; launch node types
+        for the remainder (smallest feasible type first)."""
+        to_launch: List[NodeTypeConfig] = []
+        headroom = [dict(c) for c in capacity]
+        counts = self._counts()
+        planned: Dict[str, int] = dict(counts)
+        for shape in sorted(shapes, key=lambda s: -sum(s.values())):
+            placed = False
+            for cap in headroom:
+                if all(cap.get(k, 0.0) >= v for k, v in shape.items()):
+                    for k, v in shape.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in sorted(self.node_types.values(),
+                            key=lambda t: sum(t.resources.values())):
+                if not all(t.resources.get(k, 0.0) >= v
+                           for k, v in shape.items()):
+                    continue
+                if planned.get(t.name, 0) >= t.max_workers:
+                    continue
+                to_launch.append(t)
+                planned[t.name] = planned.get(t.name, 0) + 1
+                cap = dict(t.resources)
+                for k, v in shape.items():
+                    cap[k] = cap.get(k, 0.0) - v
+                headroom.append(cap)
+                break
+        return to_launch
+
+    # -------------------------------------------------------------- monitor
+    def _monitor_loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._update()
+            except Exception:  # noqa: BLE001 — monitor must not die
+                pass
+
+    def _update(self):
+        shapes, nodes, backlog_pressure = self._observe()
+        # 1. Reap handles whose process died underneath us, then top the
+        # pool back up to min_workers (a crashed node must be replaced,
+        # not just forgotten).
+        with self._lock:
+            managed = list(self._managed)
+        for m in managed:
+            if not self.provider.poll_alive(m.handle):
+                with self._lock:
+                    if m in self._managed:
+                        self._managed.remove(m)
+        counts = self._counts()
+        for t in self.node_types.values():
+            for _ in range(t.min_workers - counts.get(t.name, 0)):
+                self._launch(t)
+        # 2. Scale up: unmet shapes first-fit against ALIVE capacity.
+        # Parked shapes that now fit an existing node are dropped — the
+        # routers' retry loops will place them without new hardware.
+        capacity = [dict(n.get("resources") or {})
+                    for n in nodes.values() if n.get("alive")]
+        for t in self._bin_pack(shapes, capacity):
+            self._launch(t)
+        # 3. Generic backlog pressure: tasks queued beyond capacity fit
+        # existing node TOTALS by definition, so they must not be
+        # first-fit against capacity — launch one CPU node per tick
+        # while no alive node reports a free CPU (the idle reaper trims
+        # any overshoot).
+        if backlog_pressure > 0:
+            free_cpu = any(
+                float(((n.get("status") or {}).get("available")
+                       or {}).get("CPU", 0.0)) >= 1.0
+                for n in nodes.values() if n.get("alive"))
+            if not free_cpu:
+                for t in sorted(self.node_types.values(),
+                                key=lambda t: sum(t.resources.values())):
+                    if t.resources.get("CPU", 0.0) >= 1.0 \
+                            and self._launch(t):
+                        break
+        # 4. Scale down idle managed nodes past the timeout.
+        now = time.monotonic()
+        counts = self._counts()
+        with self._lock:
+            managed = list(self._managed)
+        for m in managed:
+            entry = nodes.get(m.client_id)
+            if entry is None:
+                continue  # not registered yet — grace
+            status = entry.get("status") or {}
+            total = entry.get("resources") or {}
+            avail = status.get("available")
+            busy = (int(status.get("backlog", 0)) > 0
+                    or int(status.get("actors", 0)) > 0
+                    or (avail is not None and dict(avail) != dict(total)))
+            if busy:
+                m.idle_since = None
+                continue
+            if m.idle_since is None:
+                m.idle_since = now
+                continue
+            if now - m.idle_since < self.idle_timeout_s:
+                continue
+            t = self.node_types[m.type_name]
+            if counts.get(m.type_name, 0) > t.min_workers:
+                self._terminate(m)
+                counts[m.type_name] = counts.get(m.type_name, 0) - 1
+
+    def shutdown(self, terminate_nodes: bool = True):
+        self._stop.set()
+        self._monitor.join(timeout=5)
+        if terminate_nodes:
+            with self._lock:
+                managed = list(self._managed)
+            for m in managed:
+                self._terminate(m)
+        self.head.close()

@@ -173,12 +173,20 @@ class CompiledDAG:
         # driver-hosted python channel plane.
         self._shm_mode = self._select_transport(order, exec_nodes)
 
-        # Channels per node output (input node included).
+        # Mixed jax↔actor DAGs: contiguous device-hinted FunctionNode
+        # chains fuse into ONE jitted unit — their internal edges never
+        # exist as channels, and their boundary edges carry live device
+        # arrays by reference (zero readback through the driver).
+        chain_of, internal = self._fuse_device_chains(exec_nodes, consumers)
+
+        # Channels per node output (input node included). Fused-internal
+        # nodes have no observable output edge.
         self._channels: Dict[int, Any] = {}
         reader_cursor: Dict[int, int] = {}
         for node in order:
             n = consumers.get(id(node), 0)
-            if n > 0 and not isinstance(node, (MultiOutputNode, ClassNode)):
+            if n > 0 and id(node) not in internal \
+                    and not isinstance(node, (MultiOutputNode, ClassNode)):
                 self._channels[id(node)] = self._make_channel(n)
                 reader_cursor[id(node)] = 0
 
@@ -199,6 +207,23 @@ class CompiledDAG:
             if node._bound_kwargs:
                 raise ValueError(
                     "compiled DAGs require positional bind() args")
+            if id(node) in internal:
+                continue  # fused into a device chain ending elsewhere
+            if id(node) in chain_of:
+                # Fused jax unit: ONE stage running the chain's jitted
+                # program on the driver loop; args come from the HEAD's
+                # bound edges, output goes to the TAIL's channel as a
+                # live device array.
+                chain = chain_of[id(node)]
+                head = chain[0]
+                arg_sources = [_source_for(a) for a in head._bound_args]
+                out_ch = self._channels.get(id(node))
+                if out_ch is None:
+                    out_ch = self._make_channel(1)
+                self._loops.setdefault("__driver__", []).append(
+                    _Stage(node, self._jit_chain(chain), arg_sources,
+                           out_ch, ""))
+                continue
             arg_sources = [_source_for(a) for a in node._bound_args]
             out_ch = self._channels.get(id(node))
             if out_ch is None:
@@ -270,6 +295,60 @@ class CompiledDAG:
                     lambda instance, stages=stages:
                     self._exec_loop(stages, instance))
 
+    @staticmethod
+    def _is_device_node(node) -> bool:
+        return (isinstance(node, FunctionNode)
+                and getattr(node, "_transport_hint", "auto") == "device")
+
+    def _fuse_device_chains(self, exec_nodes, consumers):
+        """Group contiguous device-hinted FunctionNodes into fused jax
+        units (the mixed jax↔actor DAG). Returns (tail_chains, internal):
+        ``tail_chains`` maps id(tail node) -> the ordered node list of
+        its chain; ``internal`` is the id-set of fused non-tail members
+        (no channel, no standalone stage). A chain extends only through
+        single-consumer edges, so fusing never changes observable
+        dataflow."""
+        tail_chains: Dict[int, List[DAGNode]] = {}
+        internal: set = set()
+        for node in exec_nodes:
+            if not self._is_device_node(node):
+                continue
+            # Fusable ONLY when the previous node is the SOLE bound arg:
+            # _jit_chain calls non-head functions as f(value), so a node
+            # with extra literal args must head its own unit.
+            prev = (node._bound_args[0]
+                    if len(node._bound_args) == 1
+                    and isinstance(node._bound_args[0], DAGNode)
+                    and not isinstance(node._bound_args[0], ClassNode)
+                    else None)
+            if prev is not None and id(prev) in tail_chains \
+                    and consumers.get(id(prev), 0) == 1:
+                chain = tail_chains.pop(id(prev))
+                internal.add(id(prev))
+                chain.append(node)
+                tail_chains[id(node)] = chain
+            else:
+                tail_chains[id(node)] = [node]
+        return tail_chains, internal
+
+    @staticmethod
+    def _jit_chain(chain: List[DAGNode]):
+        """One XLA program for a fused device chain: outputs stay live
+        device arrays (no readback through the driver on device→device
+        or device→host-actor edges — the consumer receives the array by
+        reference)."""
+        import jax
+
+        fns = tuple(n.function for n in chain)
+
+        def composed(*args):
+            value = fns[0](*args)
+            for f in fns[1:]:
+                value = f(value)
+            return value
+
+        return jax.jit(composed)
+
     def _stage_descriptor(self, stages: List[_Stage]) -> bytes:
         """Wire form of one actor's stage schedule for the worker-resident
         exec loop: channel specs + per-stage sources/sinks."""
@@ -303,10 +382,17 @@ class CompiledDAG:
         hints = {getattr(n, "_transport_hint", "auto") for n in order}
         want_shm = "shm" in hints
         want_driver = "driver" in hints
-        if want_shm and want_driver:
+        want_device = "device" in hints
+        if want_shm and (want_driver or want_device):
             raise ValueError(
-                "conflicting tensor transports: both 'shm' and 'driver' "
-                "hinted in one DAG")
+                "conflicting tensor transports: 'shm' cannot mix with "
+                "'driver'/'device' hints in one DAG")
+        if want_device:
+            # Mixed jax↔actor DAG: device arrays cross edges BY
+            # REFERENCE, which requires every stage to share the
+            # driver's address space (host-actor stages should opt into
+            # runtime="driver").
+            return False
         if want_driver:
             return False
         from ray_tpu._private.worker import global_worker

@@ -32,10 +32,14 @@ class DAGNode:
           cannot run in a worker process.
         - ``"driver"``: force driver-hosted python channels (for payloads
           that must share driver memory, e.g. live jax device arrays).
+        - ``"device"``: this stage is jax-traceable — the actor-backend
+          compiler fuses contiguous device-hinted stages into ONE jitted
+          program and keeps their edges as live device arrays (by
+          reference, zero readback): the mixed jax↔actor DAG.
         - ``"auto"`` (default): shm when every actor stage is
           process-backed, driver channels otherwise.
         """
-        if transport not in ("shm", "driver", "auto"):
+        if transport not in ("shm", "driver", "auto", "device"):
             raise ValueError(f"unknown transport {transport!r}")
         self._transport_hint = transport
         return self

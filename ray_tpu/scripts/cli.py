@@ -119,6 +119,35 @@ def cmd_start(args):
                      "--address=host:port to join as a node")
 
 
+def cmd_autoscale(args):
+    """Run the cluster autoscaler against a head (reference:
+    `ray start --autoscaling-config` / the monitor process). The config
+    file is JSON: {"node_types": [{"name", "resources", "min_workers",
+    "max_workers"}], "idle_timeout_s": 5.0}."""
+    import json
+    import time as _time
+
+    from ray_tpu.autoscaler import ClusterAutoscaler, NodeTypeConfig
+
+    with open(args.config) as f:
+        cfg = json.load(f)
+    types = [NodeTypeConfig(
+        name=t["name"], resources=dict(t["resources"]),
+        min_workers=int(t.get("min_workers", 0)),
+        max_workers=int(t.get("max_workers", 10)))
+        for t in cfg["node_types"]]
+    scaler = ClusterAutoscaler(
+        args.address, types,
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 5.0)))
+    print(f"ray_tpu autoscaler managing {len(types)} node type(s) "
+          f"against {args.address}", flush=True)
+    try:
+        while True:
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        scaler.shutdown()
+
+
 def cmd_logs(args):
     """List or print worker log files of a session (reference: `ray logs`).
     """
@@ -174,6 +203,11 @@ def main(argv=None):
     p.add_argument("--num-cpus", type=int, default=2)
     p.add_argument("--resources", default="{}")
     p.set_defaults(fn=cmd_start)
+    p = sub.add_parser("autoscale")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--config", required=True,
+                   help="JSON autoscaling config (node_types)")
+    p.set_defaults(fn=cmd_autoscale)
     p = sub.add_parser("logs")
     p.add_argument("filename", nargs="?", default=None)
     p.add_argument("--session", default=None)

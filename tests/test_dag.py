@@ -518,6 +518,120 @@ def test_actor_dag_transport_hints():
     ray_tpu.shutdown()
 
 
+def test_mixed_jax_actor_dag():
+    """Mixed-backend compiled DAG: jax-traceable stages (hinted
+    'device') fuse into jitted units whose outputs cross to host actor
+    stages as LIVE device arrays — no readback through the driver — and
+    a downstream jax stage consumes the actor's output on device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    def scale(x):
+        return x * 2.0
+
+    @ray_tpu.remote
+    def shift(x):
+        return x + 1.0
+
+    @ray_tpu.remote
+    def finish(x):
+        return x - 3.0
+
+    @ray_tpu.remote(runtime="driver")
+    class Gate:
+        def __init__(self):
+            self.seen = []
+
+        def apply(self, x):
+            # Host-side control logic; the payload stays a device array.
+            self.seen.append((type(x).__name__, x is not None))
+            return x
+
+    g = Gate.remote()
+    with InputNode() as inp:
+        a = scale.bind(inp).with_tensor_transport("device")
+        b = shift.bind(a).with_tensor_transport("device")  # fuses with a
+        c = g.apply.bind(b)
+        d = finish.bind(c).with_tensor_transport("device")
+    compiled = d.experimental_compile(backend="actor")
+    try:
+        assert not compiled._shm_mode  # device edges need driver plane
+        # The two upstream jax stages fused into ONE unit: only two
+        # device stages total (fused pair + finish).
+        driver_stages = compiled._loops.get("__driver__", [])
+        assert len(driver_stages) == 2, [
+            s.method_name for s in driver_stages]
+        x = jnp.arange(8, dtype=jnp.float32)
+        out = compiled.execute(x).get(timeout=30)
+        assert np.allclose(np.asarray(out), np.arange(8) * 2.0 + 1.0 - 3.0)
+        # Residency: the actor saw a jax Array (device-resident), not a
+        # numpy readback.
+        runtime = g._runtime
+        seen = runtime.instance.seen
+        assert seen and all(
+            t in ("ArrayImpl", "Array") for t, _ in seen), seen
+        assert isinstance(out, jax.Array)
+    finally:
+        compiled.teardown()
+    ray_tpu.shutdown()
+
+
+def test_mixed_dag_literal_args_break_fusion():
+    """A device stage with an extra literal arg heads its own fused
+    unit (fusion only spans sole-arg edges) and still computes right."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2.0
+
+    @ray_tpu.remote
+    def axpy(x, k):
+        return x + k
+
+    with InputNode() as inp:
+        a = double.bind(inp).with_tensor_transport("device")
+        b = axpy.bind(a, 5.0).with_tensor_transport("device")
+    compiled = b.experimental_compile(backend="actor")
+    try:
+        # Two separate device units: the literal arg forbids fusing.
+        assert len(compiled._loops.get("__driver__", [])) == 2
+        out = compiled.execute(jnp.ones(4)).get(timeout=30)
+        assert np.allclose(np.asarray(out), np.ones(4) * 2.0 + 5.0)
+    finally:
+        compiled.teardown()
+    ray_tpu.shutdown()
+
+
+def test_mixed_dag_shm_conflict_raises():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    # One node hinted device, another shm — must conflict at compile.
+    with InputNode() as inp:
+        a = f.bind(inp).with_tensor_transport("device")
+        b = f.bind(a).with_tensor_transport("shm")
+    with pytest.raises(ValueError, match="cannot mix"):
+        b.experimental_compile(backend="actor")
+    ray_tpu.shutdown()
+
+
 def test_visualize_schedule_names_exports(ray_start_regular):
     """A sharded 3-wave DAG's rendering lists per-shard lanes and names
     the slots each wave exports through the all_gather exchange."""

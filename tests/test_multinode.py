@@ -33,28 +33,33 @@ def _spawn_head(tmp_path):
     return proc, address
 
 
-def _spawn_node(address, num_cpus, resources):
+def _spawn_node(address, num_cpus, resources, worker_mode="thread"):
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu._private.node_daemon",
          "--address", address, "--num-cpus", str(num_cpus),
-         "--resources", resources, "--worker-mode", "thread"],
+         "--resources", resources, "--worker-mode", worker_mode],
         stdout=subprocess.PIPE, text=True, env=_spawn_env())
     line = proc.stdout.readline()  # blocks until the node has joined
     assert "joined" in line
     return proc
 
 
-@pytest.fixture
-def two_node_cluster(tmp_path):
+@pytest.fixture(params=["thread", "process"])
+def two_node_cluster(request, tmp_path):
     """head + node1 {CPU:1, n1:1} + node2 {CPU:1, n2:1}, driver with no
-    local CPUs so every task must cross onto a node process."""
+    local CPUs so every task must cross onto a node process. Runs under
+    BOTH execution planes: thread-mode daemons and the default
+    process-worker plane (shm staging + kill -9 isolation), so
+    daemon-hosted worker processes execute across the machine boundary
+    in CI."""
+    mode = request.param
     os.environ["RAY_TPU_HEAD_CLIENT_TIMEOUT_S"] = "2.0"
     ray_tpu.shutdown()
     head, address = _spawn_head(tmp_path)
     node1 = node2 = None
     try:
-        node1 = _spawn_node(address, 1, '{"n1": 1}')
-        node2 = _spawn_node(address, 1, '{"n2": 1}')
+        node1 = _spawn_node(address, 1, '{"n1": 1}', mode)
+        node2 = _spawn_node(address, 1, '{"n2": 1}', mode)
         ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
                      address=address)
         yield {"address": address, "head": head,
@@ -259,6 +264,21 @@ def test_ray_client_mode_without_nodes_errors(tmp_path):
         ray_tpu.shutdown()
         head.kill()
         head.wait(timeout=5)
+
+
+def test_remote_task_env_vars_runtime_env(two_node_cluster):
+    """runtime_env crosses the push boundary: env_vars apply in the
+    node-side execution (the pip path shares this plumbing and is
+    covered by tests/test_runtime_env_pip.py locally)."""
+
+    @ray_tpu.remote(resources={"n1": 0.1},
+                    runtime_env={"env_vars": {"RTE_PROBE": "crossed"}})
+    def read_env():
+        import os as _os
+
+        return _os.environ.get("RTE_PROBE")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "crossed"
 
 
 def test_direct_peer_object_pull(two_node_cluster):

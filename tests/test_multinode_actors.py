@@ -232,6 +232,50 @@ def test_kill_remote_actor(cluster):
     assert w.head_client.actor_locate(a._actor_id.binary()) is None
 
 
+def test_actor_on_process_plane_node(tmp_path):
+    """On a process-plane daemon the hosted actor lives in a dedicated
+    WORKER process (not the daemon itself) — kill -9 isolation holds
+    across the machine boundary."""
+    os.environ["RAY_TPU_HEAD_CLIENT_TIMEOUT_S"] = "2.0"
+    ray_tpu.shutdown()
+    head, address = _spawn_head(tmp_path)
+    node = None
+    try:
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_daemon",
+             "--address", address, "--num-cpus", "1",
+             "--resources", '{"n1": 1}', "--worker-mode", "process"],
+            stdout=subprocess.PIPE, text=True, env=_spawn_env())
+        assert "joined" in node.stdout.readline()
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+        a = Counter.options(resources={"n1": 1}, max_restarts=1).remote()
+        assert ray_tpu.get(a.add.remote(3), timeout=120) == 3
+        pid = ray_tpu.get(a.pid.remote(), timeout=60)
+        assert pid not in (os.getpid(), node.pid)  # dedicated process
+        # kill -9 the actor's worker process: the node-local restart
+        # policy respawns it with fresh state on the same node.
+        os.kill(pid, 9)
+        deadline = time.monotonic() + 30
+        value = None
+        while time.monotonic() < deadline:
+            try:
+                value = ray_tpu.get(a.add.remote(1), timeout=10)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert value == 1  # fresh state
+        pid2 = ray_tpu.get(a.pid.remote(), timeout=30)
+        assert pid2 != pid and pid2 not in (os.getpid(), node.pid)
+    finally:
+        ray_tpu.shutdown()
+        for p in (node, head):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=5)
+        os.environ.pop("RAY_TPU_HEAD_CLIENT_TIMEOUT_S", None)
+
+
 def test_serve_replicas_spread_across_nodes(cluster):
     """serve.run with multiple replicas places them across both node
     daemons; routed calls hit more than one machine."""
