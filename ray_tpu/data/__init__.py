@@ -18,6 +18,13 @@ from ray_tpu.data.grouped import (
     Std,
     Sum,
 )
+from ray_tpu.data.datasource import Datasink, Datasource, ReadTask
+from ray_tpu.data.filesystem import (
+    Filesystem,
+    MemoryFilesystem,
+    register_filesystem,
+    resolve_filesystem,
+)
 from ray_tpu.data.read_api import (
     from_arrow,
     from_columns,
@@ -31,13 +38,17 @@ from ray_tpu.data.read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_tfrecords,
 )
 from ray_tpu.data.stats import DatasetStats
 
 __all__ = [
     "AggregateFn", "Block", "BlockMetadata", "Count", "Dataset",
-    "DatasetStats", "MaterializedDataset", "Max", "Mean", "Min", "Std",
-    "Sum", "from_arrow", "from_columns", "from_items", "from_numpy",
-    "from_pandas", "range", "read_binary_files", "read_csv",
-    "read_datasource", "read_json", "read_numpy", "read_parquet",
+    "Datasink", "Datasource", "DatasetStats", "Filesystem",
+    "MaterializedDataset", "Max", "Mean", "MemoryFilesystem", "Min",
+    "ReadTask", "Std", "Sum", "from_arrow", "from_columns",
+    "from_items", "from_numpy", "from_pandas", "range",
+    "read_binary_files", "read_csv", "read_datasource", "read_json",
+    "read_numpy", "read_parquet", "read_tfrecords",
+    "register_filesystem", "resolve_filesystem",
 ]

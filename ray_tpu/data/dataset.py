@@ -398,32 +398,70 @@ class Dataset:
                 for i in range(n)]
 
     # --------------------------------------------------------------- writes
-    def write_parquet(self, path: str) -> None:
-        import os
+    @staticmethod
+    def _out_fs(path: str):
+        """(filesystem, stripped path) with the output dir ensured —
+        write paths accept any registered scheme (local, memory://,
+        fsspec)."""
+        from ray_tpu.data.filesystem import resolve_filesystem
 
+        fs, p = resolve_filesystem(path)
+        fs.makedirs(p)
+        return fs, p.rstrip("/")
+
+    def write_parquet(self, path: str) -> None:
         import pyarrow.parquet as pq
 
-        os.makedirs(path, exist_ok=True)
+        fs, p = self._out_fs(path)
         for i, block in enumerate(self.iter_blocks()):
-            pq.write_table(block_to_arrow(block),
-                           os.path.join(path, f"part-{i:05d}.parquet"))
+            with fs.open(f"{p}/part-{i:05d}.parquet", "wb") as fh:
+                pq.write_table(block_to_arrow(block), fh)
 
     def write_csv(self, path: str) -> None:
-        import os
-
-        os.makedirs(path, exist_ok=True)
+        fs, p = self._out_fs(path)
         for i, block in enumerate(self.iter_blocks()):
-            block_to_pandas(block).to_csv(
-                os.path.join(path, f"part-{i:05d}.csv"), index=False)
+            text = block_to_pandas(block).to_csv(index=False)
+            with fs.open(f"{p}/part-{i:05d}.csv", "wb") as fh:
+                fh.write(text.encode())
 
     def write_json(self, path: str) -> None:
-        import os
-
-        os.makedirs(path, exist_ok=True)
+        fs, p = self._out_fs(path)
         for i, block in enumerate(self.iter_blocks()):
-            block_to_pandas(block).to_json(
-                os.path.join(path, f"part-{i:05d}.json"),
+            text = block_to_pandas(block).to_json(
                 orient="records", lines=True)
+            with fs.open(f"{p}/part-{i:05d}.json", "wb") as fh:
+                fh.write(text.encode())
+
+    def write_tfrecords(self, path: str) -> None:
+        """Write blocks as TFRecord files of tf.train.Example protos
+        (one file per block; no tensorflow dependency)."""
+        from ray_tpu.data.block import block_num_rows
+        from ray_tpu.data.tfrecords import encode_example, write_record
+
+        fs, p = self._out_fs(path)
+        for i, block in enumerate(self.iter_blocks()):
+            with fs.open(f"{p}/part-{i:05d}.tfrecords", "wb") as fh:
+                n = block_num_rows(block)
+                for r in range(n):
+                    row = {k: v[r] for k, v in block.items()}
+                    write_record(fh, encode_example(row))
+
+    def write_datasink(self, sink) -> list:
+        """Stream this dataset's blocks into a custom Datasink with the
+        start/complete/failure lifecycle (reference Datasink parity)."""
+        sink.on_write_start()
+        results = []
+        try:
+            for block in self.iter_blocks():
+                results.append(sink.write([block]))
+        except Exception as exc:
+            try:
+                sink.on_write_failed(exc)
+            except Exception:  # noqa: BLE001 — sink hook bug
+                pass
+            raise
+        sink.on_write_complete(results)
+        return results
 
     def to_pandas(self):
         return block_to_pandas(

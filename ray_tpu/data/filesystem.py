@@ -1,0 +1,208 @@
+"""Pluggable filesystems for Data IO (reference role: the pyarrow/fsspec
+filesystem plumbing in python/ray/data/datasource/path_util.py +
+file_based_datasource.py [unverified]).
+
+Paths may carry a URI scheme (``memory://bucket/x``, ``s3://…``); the
+registry resolves the scheme to a Filesystem. ``file`` (or no scheme)
+is the local filesystem; ``memory`` is a process-global in-memory store
+(the remote-fs-shaped backend used in tests); any other scheme defers
+to fsspec when installed.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import Dict, List, Tuple
+
+
+class Filesystem:
+    """Minimal surface the Data readers/writers need."""
+
+    def open(self, path: str, mode: str = "rb"):
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        """Recursive FILE listing under a directory path."""
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def isdir(self, path: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalFilesystem(Filesystem):
+    def open(self, path, mode="rb"):
+        return open(path, mode)
+
+    def listdir(self, path):
+        out = []
+        for root, _, files in os.walk(path):
+            out.extend(os.path.join(root, f) for f in files)
+        return sorted(out)
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def isdir(self, path):
+        return os.path.isdir(path)
+
+
+class _MemFile(io.BytesIO):
+    def __init__(self, fs, key):
+        super().__init__()
+        self._fs = fs
+        self._key = key
+
+    def close(self):
+        self._fs._put(self._key, self.getvalue())
+        super().close()
+
+
+class MemoryFilesystem(Filesystem):
+    """In-memory filesystem (remote-object-store shaped: flat keys,
+    ``isdir`` is prefix-existence). Backed by the ray_tpu internal KV
+    when a runtime is up, so read tasks in WORKER PROCESSES (and on
+    other nodes, via the head KV) see files the driver wrote; a plain
+    process-local dict otherwise."""
+
+    _KV_PREFIX = b"memfs|"
+    _store: Dict[str, bytes] = {}  # no-runtime fallback
+    _lock = threading.Lock()
+
+    @staticmethod
+    def _worker():
+        try:
+            from ray_tpu._private.worker import _try_global_worker
+
+            w = _try_global_worker()
+            return w if w is not None and w.is_alive else None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            return None
+
+    def _put(self, key: str, data: bytes):
+        w = self._worker()
+        if w is not None:
+            w.kv_put(self._KV_PREFIX + key.encode(), data)
+            return
+        with self._lock:
+            self._store[key] = data
+
+    def _get(self, key: str):
+        w = self._worker()
+        if w is not None:
+            return w.kv_get(self._KV_PREFIX + key.encode())
+        with self._lock:
+            return self._store.get(key)
+
+    def _keys(self, prefix: str):
+        w = self._worker()
+        if w is not None:
+            n = len(self._KV_PREFIX)
+            return [k[n:].decode() for k in w.kv_keys(
+                self._KV_PREFIX + prefix.encode())]
+        with self._lock:
+            return [k for k in self._store if k.startswith(prefix)]
+
+    def open(self, path, mode="rb"):
+        path = path.rstrip("/")
+        if "r" in mode:
+            data = self._get(path)
+            if data is None:
+                raise FileNotFoundError(path)
+            return io.BytesIO(data)
+        return _MemFile(self, path)
+
+    def listdir(self, path):
+        return sorted(self._keys(path.rstrip("/") + "/"))
+
+    def makedirs(self, path):
+        pass  # flat namespace
+
+    def exists(self, path):
+        path = path.rstrip("/")
+        return self._get(path) is not None or bool(
+            self._keys(path + "/"))
+
+    def isdir(self, path):
+        return bool(self._keys(path.rstrip("/") + "/"))
+
+    def delete(self, path):
+        w = self._worker()
+        if w is not None:
+            w.kv_del(self._KV_PREFIX + path.encode())
+        with self._lock:
+            self._store.pop(path, None)
+
+    @classmethod
+    def clear(cls):
+        fs = cls()
+        for k in fs._keys(""):
+            fs.delete(k)
+        with cls._lock:
+            cls._store.clear()
+
+
+class _FsspecFilesystem(Filesystem):
+    def __init__(self, fs, scheme: str):
+        self._fs = fs
+        self._scheme = scheme
+
+    def open(self, path, mode="rb"):
+        return self._fs.open(path, mode)
+
+    def listdir(self, path):
+        # fsspec's find() strips the scheme; re-qualify so returned
+        # paths stay resolvable through the registry.
+        return sorted(
+            f"{self._scheme}://{p}" if "://" not in p else p
+            for p in self._fs.find(path)
+            if not self._fs.isdir(p))
+
+    def makedirs(self, path):
+        self._fs.makedirs(path, exist_ok=True)
+
+    def exists(self, path):
+        return self._fs.exists(path)
+
+    def isdir(self, path):
+        return self._fs.isdir(path)
+
+
+_REGISTRY: Dict[str, Filesystem] = {
+    "file": LocalFilesystem(),
+    "memory": MemoryFilesystem(),
+}
+
+
+def register_filesystem(scheme: str, fs: Filesystem) -> None:
+    _REGISTRY[scheme] = fs
+
+
+def resolve_filesystem(path: str) -> Tuple[Filesystem, str]:
+    """(filesystem, scheme-stripped path) for a possibly-URI path."""
+    if "://" not in path:
+        return _REGISTRY["file"], path
+    scheme, _, rest = path.partition("://")
+    fs = _REGISTRY.get(scheme)
+    if fs is not None:
+        if scheme == "memory":
+            return fs, scheme + "://" + rest  # keep keys scheme-qualified
+        return fs, rest
+    try:
+        import fsspec
+
+        return _FsspecFilesystem(fsspec.filesystem(scheme), scheme), path
+    except Exception as exc:  # noqa: BLE001 — unknown scheme
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} and fsspec "
+            f"could not provide one: {exc}") from exc

@@ -91,12 +91,32 @@ def from_arrow(table, *, parallelism: int = 1) -> Dataset:
          for c in table.column_names}, parallelism=parallelism)
 
 
+def _open_path(path: str, mode: str = "rb"):
+    """Open a path through the filesystem registry (local, memory://,
+    or any fsspec scheme)."""
+    from ray_tpu.data.filesystem import resolve_filesystem
+
+    fs, p = resolve_filesystem(path)
+    return fs.open(p, mode)
+
+
 def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        if os.path.isdir(p):
+        if "://" in p:
+            # Scheme-qualified: resolve through the filesystem registry
+            # (remote-fs read path).
+            from ray_tpu.data.filesystem import resolve_filesystem
+
+            fs, fp = resolve_filesystem(p)
+            if fs.isdir(fp):
+                out.extend(f for f in fs.listdir(fp)
+                           if suffix is None or f.endswith(suffix))
+            else:
+                out.append(p)
+        elif os.path.isdir(p):
             out.extend(sorted(
                 f for f in globlib.glob(os.path.join(p, "**", "*"),
                                         recursive=True)
@@ -178,7 +198,11 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None,
         def task() -> List[Block]:
             import pyarrow.parquet as pq
 
-            table = pq.read_table(f, columns=file_columns)
+            if "://" in f:
+                with _open_path(f) as fh:
+                    table = pq.read_table(fh, columns=file_columns)
+            else:
+                table = pq.read_table(f, columns=file_columns)
             block = dict(normalize_block(table))
             n = len(next(iter(block.values()))) if block else table.num_rows
             for k, v in part_values.items():  # paths -> columns
@@ -199,6 +223,11 @@ def read_csv(paths, **read_opts) -> Dataset:
         def task() -> List[Block]:
             import pandas as pd
 
+            if "://" in f:
+                with _open_path(f) as fh:
+                    return [normalize_block(pd.read_csv(fh, **read_opts))]
+            # Local paths go through pandas directly so its
+            # compression-by-extension inference (.csv.gz) keeps working.
             return [normalize_block(pd.read_csv(f, **read_opts))]
 
         return task
@@ -214,6 +243,9 @@ def read_json(paths, **read_opts) -> Dataset:
             import pandas as pd
 
             read_opts.setdefault("lines", True)
+            if "://" in f:
+                with _open_path(f) as fh:
+                    return [normalize_block(pd.read_json(fh, **read_opts))]
             return [normalize_block(pd.read_json(f, **read_opts))]
 
         return task
@@ -226,6 +258,9 @@ def read_numpy(paths, **_opts) -> Dataset:
 
     def make_task(f):
         def task() -> List[Block]:
+            if "://" in f:
+                with _open_path(f) as fh:
+                    return [{"data": np.load(fh)}]
             return [{"data": np.load(f)}]
 
         return task
@@ -238,7 +273,7 @@ def read_binary_files(paths, **_opts) -> Dataset:
 
     def make_task(f):
         def task() -> List[Block]:
-            with open(f, "rb") as fh:
+            with _open_path(f) as fh:
                 data = fh.read()
             return [{"path": np.asarray([f], dtype=object),
                      "bytes": np.asarray([data], dtype=object)}]
@@ -246,6 +281,30 @@ def read_binary_files(paths, **_opts) -> Dataset:
         return task
 
     return _from_read_tasks("ReadBinary", [make_task(f) for f in files])
+
+
+def read_tfrecords(paths, **_opts) -> Dataset:
+    """Read TFRecord files of tf.train.Example protos (no tensorflow
+    dependency — see ray_tpu/data/tfrecords.py for the record framing +
+    protobuf codec). Each feature key becomes a column; single-element
+    features scalarize."""
+    files = _expand_paths(paths)
+
+    def make_task(f):
+        def task() -> List[Block]:
+            from ray_tpu.data.tfrecords import (
+                decode_example,
+                examples_to_block,
+                read_records,
+            )
+
+            with _open_path(f) as fh:
+                rows = [decode_example(r) for r in read_records(fh)]
+            return [examples_to_block(rows)]
+
+        return task
+
+    return _from_read_tasks("ReadTFRecords", [make_task(f) for f in files])
 
 
 def read_datasource(datasource, *, parallelism: int = 8, **opts) -> Dataset:

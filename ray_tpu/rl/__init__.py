@@ -15,6 +15,12 @@ from ray_tpu.rl.dqn import DQNConfig, DQNLearner
 from ray_tpu.rl.replay import ReplayBuffer
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.offline import (
+    buffer_to_dataset,
+    dataset_to_buffer,
+    train_dqn_offline,
+)
 from ray_tpu.rl.multi_agent import (
     CoordinationGame,
     MultiAgentEnvRunner,
@@ -33,9 +39,14 @@ __all__ = [
     "DQNConfig",
     "DQNLearner",
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
     "JaxEnv",
     "PPOConfig",
     "PPOLearner",
     "Pendulum",
     "ReplayBuffer",
+    "buffer_to_dataset",
+    "dataset_to_buffer",
+    "train_dqn_offline",
 ]

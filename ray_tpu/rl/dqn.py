@@ -101,6 +101,15 @@ class DQNLearner:
             np.asarray(rollout.dones)[:-1], obs[1:])
         if len(self._buffer) < self.config.min_buffer_size:
             return float("nan")
+        return self.train_from_buffer()
+
+    def train_from_buffer(self) -> float:
+        """One iteration of gradient steps from the CURRENT buffer
+        contents — the offline path (rl/offline.py) fills the buffer
+        from a Dataset and calls this with no env interaction.
+        Minibatch sampling is seeded by the learner's numpy RNG."""
+        if len(self._buffer) == 0:
+            return float("nan")
         k = self.config.train_steps_per_iter
         samples = [self._buffer.sample(self.config.batch_size, self._rng)
                    for _ in range(k)]

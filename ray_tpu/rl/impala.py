@@ -1,0 +1,206 @@
+"""IMPALA-style asynchronous learner (reference role:
+rllib/algorithms/impala — env-runner actors stream rollouts into a
+learner that updates while collection continues, with V-trace
+importance correction for the policy lag [unverified]).
+
+TPU-first shape: each runner actor's whole vectorized rollout is one
+jitted device program (see env_runner.py); the learner's V-trace update
+is one jitted program. Asynchrony is the scheduling layer between them:
+one sample stays in flight PER RUNNER at all times — when a rollout
+lands, the runner is immediately re-armed with the freshest weights
+BEFORE the learner consumes the data, so collection genuinely overlaps
+the update (measured and reported in train() stats).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.env import JaxEnv
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.ppo import Rollout, init_policy, policy_logits, value_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class IMPALAConfig:
+    hidden: tuple = (64, 64)
+    lr: float = 5e-3
+    gamma: float = 0.99
+    rho_clip: float = 1.0     # V-trace importance-weight clip (rho-bar)
+    c_clip: float = 1.0       # V-trace trace-cutting clip (c-bar)
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    max_grad_norm: float = 0.5
+
+
+def vtrace(behavior_logp, target_logp, rewards, dones, values, v_boot,
+           gamma, rho_clip, c_clip):
+    """V-trace targets + policy-gradient advantages (arXiv:1802.01561
+    shape): reverse scan over the [T, N] rollout."""
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rho, rho_clip)
+    c_bar = jnp.minimum(rho, c_clip)
+    discounts = gamma * (1.0 - dones)
+    v_next = jnp.concatenate([values[1:], v_boot[None]], axis=0)
+    deltas = rho_bar * (rewards + discounts * v_next - values)
+
+    def scan_fn(acc, inp):
+        delta, disc, c = inp
+        acc = delta + disc * c * acc
+        return acc, acc
+
+    _, corrections = jax.lax.scan(
+        scan_fn, jnp.zeros_like(v_boot),
+        (deltas, discounts, c_bar), reverse=True)
+    vs = values + corrections
+    vs_next = jnp.concatenate([vs[1:], v_boot[None]], axis=0)
+    pg_adv = rho_bar * (rewards + discounts * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv), rho
+
+
+class IMPALA:
+    """Async actor-learner over ray_tpu env-runner actors."""
+
+    def __init__(self, env: JaxEnv, config: IMPALAConfig = IMPALAConfig(),
+                 *, num_runners: int = 2, num_envs: int = 32,
+                 rollout_len: int = 64, seed: int = 0):
+        ray_tpu.init(ignore_reinit_error=True)
+        self.env = env
+        self.config = config
+        self.params = init_policy(
+            jax.random.PRNGKey(seed), env.obs_dim, env.num_actions,
+            config.hidden)
+        self._opt = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self._opt_state = self._opt.init(self.params)
+        self._runners = [
+            EnvRunner.as_actor(env, num_envs, rollout_len, seed=seed + i)
+            for i in range(num_runners)]
+        self.steps_per_sample = num_envs * rollout_len
+        self._update = self._make_update()
+        self.stats: Dict[str, float] = {}
+
+    def _make_update(self):
+        cfg = self.config
+
+        def loss_fn(params, rollout: Rollout):
+            T, N = rollout.actions.shape
+            obs = rollout.obs.reshape(T * N, -1)
+            logits = policy_logits(params, obs).reshape(T, N, -1)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, rollout.actions[..., None].astype(jnp.int32),
+                -1)[..., 0]
+            values = value_fn(params, obs).reshape(T, N)
+            # Bootstrap with the BEHAVIOR policy's last value: the
+            # runner evaluated it on obs_{T} which the Rollout does not
+            # carry — the one-step bias vanishes under rho-clipping.
+            v_boot = rollout.values[-1]
+            vs, pg_adv, _ = vtrace(
+                rollout.log_probs, logp, rollout.rewards, rollout.dones,
+                values, v_boot, cfg.gamma, cfg.rho_clip, cfg.c_clip)
+            policy_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            return (policy_loss + cfg.vf_coef * vf_loss
+                    - cfg.entropy_coef * entropy)
+
+        @jax.jit
+        def update(params, opt_state, rollout):
+            loss, grads = jax.value_and_grad(loss_fn)(params, rollout)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return update
+
+    # ------------------------------------------------------------- training
+    def train(self, num_updates: int = 50) -> Dict[str, float]:
+        """Run the async loop for `num_updates` learner steps. Returns
+        stats including the measured collection/update overlap."""
+        t_start = time.perf_counter()
+        host_params = jax.device_get(self.params)
+        inflight = {}
+        submit_ts = {}
+        for i, r in enumerate(self._runners):
+            ref = r.sample.remote(host_params)
+            inflight[ref] = i
+            submit_ts[ref] = time.perf_counter()
+        losses = []
+        update_wall = 0.0
+        overlap_s = 0.0
+        done_rates = []
+        updates = 0
+        while updates < num_updates:
+            ready, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                    timeout=120.0)
+            if not ready:
+                raise TimeoutError("env runners stalled")
+            ref = ready[0]
+            idx = inflight.pop(ref)
+            submit_ts.pop(ref, None)
+            rollout = ray_tpu.get(ref)
+            # Re-arm the runner FIRST: its next rollout collects while
+            # the learner runs the update below — that concurrency is
+            # the entire point of the architecture.
+            host_params = jax.device_get(self.params)
+            ref2 = self._runners[idx].sample.remote(host_params)
+            inflight[ref2] = idx
+            submit_ts[ref2] = time.perf_counter()
+            t0 = time.perf_counter()
+            rollout = jax.tree.map(jnp.asarray, rollout)
+            self.params, self._opt_state, loss = self._update(
+                self.params, self._opt_state, rollout)
+            loss = float(loss)  # blocks: honest update timing
+            t1 = time.perf_counter()
+            update_wall += t1 - t0
+            # Overlap measurement: samples submitted BEFORE this update
+            # started and still in flight when it finished were being
+            # collected for its entire duration.
+            if any(ts <= t0 for ts in submit_ts.values()):
+                overlap_s += t1 - t0
+            losses.append(loss)
+            done_rates.append(float(jnp.mean(rollout.dones)))
+            updates += 1
+        wall = time.perf_counter() - t_start
+        self.stats = {
+            "updates": updates,
+            "loss": float(np.mean(losses[-10:])),
+            "env_steps": updates * self.steps_per_sample,
+            "env_steps_per_sec": updates * self.steps_per_sample / wall,
+            "update_wall_s": update_wall,
+            "collection_update_overlap_s": overlap_s,
+            "total_wall_s": wall,
+            "episode_len_mean": (1.0 / np.mean(done_rates[-10:])
+                                 if np.mean(done_rates[-10:]) > 0
+                                 else float("nan")),
+        }
+        return dict(self.stats)
+
+    def get_weights(self):
+        return self.params
+
+    def evaluate(self, num_episodes: int = 8) -> Dict[str, float]:
+        from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+        algo = Algorithm(AlgorithmConfig("PPO").environment(
+            env_factory=lambda: self.env))
+        algo.learner.set_weights(self.params)
+        return algo.evaluate(num_episodes)
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        self._runners = []

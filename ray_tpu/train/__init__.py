@@ -26,6 +26,7 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.storage import CheckpointStore
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
 
 # Reference-name alias: users arriving from the reference find the same
@@ -37,6 +38,7 @@ __all__ = [
     "Callback",
     "Checkpoint",
     "CheckpointConfig",
+    "CheckpointStore",
     "DataParallelTrainer",
     "JsonLoggerCallback",
     "TransformersTrainer",

@@ -55,6 +55,21 @@ class Checkpoint:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return Checkpoint(dest)
 
+    # ----------------------------------------------------------- URI plane
+    def to_uri(self, uri: str) -> str:
+        """Upload this checkpoint to a storage URI (memory://, any
+        fsspec scheme) through the filesystem registry."""
+        from ray_tpu.train.storage import upload_dir
+
+        return upload_dir(self.path, uri)
+
+    @staticmethod
+    def from_uri(uri: str) -> "Checkpoint":
+        """Materialize a stored checkpoint locally from its URI."""
+        from ray_tpu.train.storage import download_dir
+
+        return Checkpoint(download_dir(uri))
+
     def __repr__(self):
         return f"Checkpoint({self.path})"
 

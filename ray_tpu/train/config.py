@@ -26,6 +26,10 @@ class FailureConfig:
 class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_frequency: int = 0
+    # Persist checkpoints on a background upload thread so the trainer's
+    # report-drain loop (and therefore the training step cadence) never
+    # blocks on storage IO; drained once at fit() end.
+    async_save: bool = False
 
 
 @dataclasses.dataclass

@@ -51,12 +51,15 @@ class JaxTrainer:
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
         self._datasets = datasets or {}
+        self._restore_from: Optional[Checkpoint] = None
+        self._ckpt_store = None  # lazy CheckpointStore over storage_path
 
     # ------------------------------------------------------------------ fit
     def fit(self) -> Result:
         ray_tpu.init(ignore_reinit_error=True)
+        self._save_trainer_state()
         failures_allowed = self._run_config.failure_config.max_failures
-        latest_ckpt: Optional[Checkpoint] = None
+        latest_ckpt: Optional[Checkpoint] = self._restore_from
         history: List[Dict[str, Any]] = []
         attempt = 0
         result = None
@@ -76,6 +79,23 @@ class JaxTrainer:
                     raise TrainingFailedError(
                         f"training failed after {attempt - 1} restart(s): "
                         f"{exc!r}") from exc
+        # Drain any background checkpoint uploads before declaring the
+        # run complete (async_save keeps them off the step loop).
+        if self._ckpt_store is not None:
+            try:
+                self._ckpt_store.wait(timeout=120)
+            except Exception:  # noqa: BLE001 — upload failure is IO, not
+                pass  # training; the local checkpoint remains valid
+            # Retention runs AFTER uploads land so async_save honors
+            # num_to_keep too (per-persist pruning covers the sync path).
+            keep = self._run_config.checkpoint_config.num_to_keep
+            if keep:
+                try:
+                    for stale in \
+                            self._ckpt_store.list_checkpoints()[:-keep]:
+                        self._ckpt_store.delete(stale)
+                except Exception:  # noqa: BLE001 — best-effort retention
+                    pass
         # Callbacks close OUTSIDE the retry boundary: a logger bug must
         # not discard a completed training run (per-record on_result
         # already streamed live from _run_attempt's drain loop).
@@ -90,9 +110,48 @@ class JaxTrainer:
         rc = self._run_config
         if rc.storage_path is None:
             return None
+        if "://" in rc.storage_path:  # remote storage URI
+            return f"{rc.storage_path.rstrip('/')}/{rc.name or 'train_run'}"
         d = os.path.join(rc.storage_path, rc.name or "train_run")
         os.makedirs(d, exist_ok=True)
         return d
+
+    def _store(self):
+        """Lazy CheckpointStore over the run's storage root (local dir
+        or remote URI)."""
+        if self._ckpt_store is None:
+            root = self._storage_dir()
+            if root is None:
+                return None
+            from ray_tpu.train.storage import CheckpointStore
+
+            self._ckpt_store = CheckpointStore(root)
+        return self._ckpt_store
+
+    def _save_trainer_state(self):
+        """Persist enough to rebuild this trainer (loop + configs) so
+        ``JaxTrainer.restore(uri)`` works from storage alone (reference:
+        trainer.pkl in the run directory)."""
+        root = self._storage_dir()
+        if root is None:
+            return
+        import cloudpickle
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+
+        state = cloudpickle.dumps({
+            "loop": self._loop,
+            "loop_config": self._loop_config,
+            "scaling": self._scaling,
+            "run_config": self._run_config,
+        }, protocol=5)
+        try:
+            fs, p = resolve_filesystem(root)
+            fs.makedirs(p)
+            with fs.open(p.rstrip("/") + "/trainer.pkl", "wb") as f:
+                f.write(state)
+        except Exception:  # noqa: BLE001 — unpicklable loop: restore()
+            pass  # falls back to requiring an explicit loop argument
 
     # -------------------------------------------------------------- attempt
     def _run_attempt(self, restore_from: Optional[Checkpoint]):
@@ -213,27 +272,74 @@ class JaxTrainer:
         return latest_metrics, latest_ckpt, history
 
     def _persist(self, ckpt: Checkpoint) -> Checkpoint:
-        storage = self._storage_dir()
-        if storage is None:
+        store = self._store()
+        if store is None:
             return ckpt
-        dest = os.path.join(
-            storage, f"checkpoint_{time.monotonic_ns()}")
-        out = ckpt.copy_to(dest)
-        keep = self._run_config.checkpoint_config.num_to_keep
-        if keep:
-            ckpts = sorted(
-                d for d in os.listdir(storage)
-                if d.startswith("checkpoint_"))
-            for stale in ckpts[:-keep]:
-                import shutil
-
-                shutil.rmtree(os.path.join(storage, stale),
-                              ignore_errors=True)
+        # Wall-clock, zero-padded: lexicographic order == creation order
+        # even across process restarts (monotonic_ns resets per boot and
+        # varies in digit count, which would mis-order restore()).
+        name = f"checkpoint_{time.time_ns():020d}"
+        cc = self._run_config.checkpoint_config
+        if cc.async_save:
+            # Upload off the drain loop; the LOCAL checkpoint stays
+            # authoritative for restarts until the upload lands.
+            store.persist_async(ckpt, name)
+            out = ckpt
+        else:
+            dest = store.persist(ckpt, name)
+            out = Checkpoint(dest) if not store.remote else ckpt
+        keep = cc.num_to_keep
+        if keep and not cc.async_save:
+            for stale in store.list_checkpoints()[:-keep]:
+                store.delete(stale)
         return out
 
     @staticmethod
-    def restore(path: str, **kwargs) -> "JaxTrainer":
-        raise NotImplementedError(
-            "restore(): construct a new trainer and pass the checkpoint "
-            "via RunConfig.storage_path; trial-level restore lands with "
-            "tune.Tuner.restore")
+    def restore(path: str, train_loop_per_worker=None,
+                **overrides) -> "JaxTrainer":
+        """Rebuild a trainer from its storage root (local dir or URI):
+        the persisted trainer state supplies loop + configs (explicit
+        arguments override), and training resumes from the LATEST stored
+        checkpoint (reference: Trainer.restore(path))."""
+        from ray_tpu.data.filesystem import resolve_filesystem
+        from ray_tpu.train.storage import CheckpointStore
+
+        state = {}
+        try:
+            fs, p = resolve_filesystem(path)
+            with fs.open(p.rstrip("/") + "/trainer.pkl", "rb") as f:
+                import cloudpickle
+
+                state = cloudpickle.loads(f.read())
+        except Exception:  # noqa: BLE001 — no persisted state
+            if train_loop_per_worker is None:
+                raise ValueError(
+                    f"no trainer state at {path!r}; pass "
+                    f"train_loop_per_worker explicitly") from None
+        run_config = overrides.pop("run_config", None) \
+            or state.get("run_config")
+        if run_config is None:
+            # No persisted state: derive storage from the restore path
+            # itself so the resumed run KEEPS persisting checkpoints to
+            # the root it was restored from.
+            clean = path.rstrip("/")
+            if "://" in clean:
+                root, _, name = clean.rpartition("/")
+            else:
+                root, name = os.path.split(clean)
+            run_config = RunConfig(name=name or None,
+                                   storage_path=root or None)
+        trainer = JaxTrainer(
+            train_loop_per_worker or state.get("loop"),
+            train_loop_config=overrides.pop(
+                "train_loop_config", state.get("loop_config")),
+            scaling_config=overrides.pop(
+                "scaling_config", state.get("scaling")),
+            run_config=run_config,
+            **overrides,
+        )
+        # The storage root IS `path`; resume from its latest checkpoint.
+        store = CheckpointStore(path)
+        trainer._ckpt_store = None  # rebuilt lazily from run_config
+        trainer._restore_from = store.latest()
+        return trainer
