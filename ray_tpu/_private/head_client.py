@@ -59,11 +59,28 @@ def parse_address(address: str) -> Tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
+def parse_addresses(address: str) -> list:
+    """Comma-separated head addresses: primary first, then standbys
+    (GCS-FT failover list — the client dials them in order)."""
+    return [parse_address(a.strip())
+            for a in address.split(",") if a.strip()]
+
+
 class HeadClient:
     def __init__(self, address: str, client_id: Optional[str] = None,
                  token: Optional[str] = None):
-        self.address = parse_address(address)
-        self.token = resolve_token(self.address[1], token)
+        self.addresses = parse_addresses(address)
+        self.address = self.addresses[0]
+        self.token = None
+        last: Optional[Exception] = None
+        for _, port in self.addresses:
+            try:
+                self.token = resolve_token(port, token)
+                break
+            except ConnectionError as exc:
+                last = exc
+        if self.token is None:
+            raise last or ConnectionError("no cluster token resolvable")
         self.client_id = client_id or f"driver-{uuid.uuid4().hex[:8]}"
         # Extension points: the node daemon serves task pushes; the
         # driver's remote router consumes task completions.
@@ -111,10 +128,22 @@ class HeadClient:
 
     # ------------------------------------------------------------ plumbing
     def _dial(self, role: str) -> FramedConnection:
-        conn = connect(*self.address, self.token)
-        conn.send(("hello", self.client_id, role))
-        self._check(conn.recv())
-        return conn
+        """Dial the active head; on failure try the other configured
+        addresses (standby failover) — whichever answers becomes the
+        active address for subsequent dials."""
+        ordered = [self.address] + [a for a in self.addresses
+                                    if a != self.address]
+        last: Optional[Exception] = None
+        for addr in ordered:
+            try:
+                conn = connect(*addr, self.token, timeout=5.0)
+                conn.send(("hello", self.client_id, role))
+                self._check(conn.recv())
+                self.address = addr
+                return conn
+            except Exception as exc:  # noqa: BLE001 — try next head
+                last = exc
+        raise last if last is not None else ConnectionError("no head")
 
     @staticmethod
     def _check(reply):

@@ -722,6 +722,41 @@ class HeadService:
             self._log.close()
 
 
+def run_standby(primary: str, token: str, probe_period_s: float = 1.0,
+                misses_to_promote: int = 3) -> None:
+    """Warm-standby loop (GCS-FT replicated-head role): probe the
+    primary's request channel; after `misses_to_promote` consecutive
+    failures, return so the caller promotes this process to a serving
+    head over the SHARED state log. Clients configured with
+    ``address="primary,standby"`` fail over on their next dial."""
+    import uuid
+
+    from ray_tpu._private.transport import connect as _connect
+
+    host, _, port = primary.rpartition(":")
+    misses = 0
+    probe_id = f"standby-{uuid.uuid4().hex[:8]}"
+    while misses < misses_to_promote:
+        time.sleep(probe_period_s)
+        try:
+            conn = _connect(host or "127.0.0.1", int(port), token,
+                            timeout=2.0)
+            conn.send(("hello", probe_id, "request"))
+            conn.recv()
+            conn.close()
+            misses = 0
+        except ConnectionError as exc:
+            if "token mismatch" in str(exc):
+                # The primary is ALIVE and rejected our token: promoting
+                # would split-brain the shared log with two writers.
+                raise SystemExit(
+                    "standby token does not match the primary's cluster "
+                    "token — refusing to promote") from exc
+            misses += 1
+        except Exception:  # noqa: BLE001 — primary unreachable
+            misses += 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -729,7 +764,21 @@ def main(argv=None) -> int:
     ap.add_argument("--state", default=None,
                     help="append-log path for head fault tolerance")
     ap.add_argument("--token", default=None)
+    ap.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                    help="run as a warm standby: serve only after this "
+                         "primary (sharing --state) stops answering")
     args = ap.parse_args(argv)
+    if args.standby_of:
+        token = (args.token or os.environ.get("RAY_TPU_CLUSTER_TOKEN"))
+        if not token or not args.state:
+            raise SystemExit(
+                "--standby-of needs --state (the shared log) and an "
+                "explicit token (--token / RAY_TPU_CLUSTER_TOKEN)")
+        print(f"ray_tpu head standing by for {args.standby_of}",
+              flush=True)
+        run_standby(args.standby_of, token)
+        print("ray_tpu standby promoting: primary unreachable",
+              flush=True)
     svc = HeadService(args.host, args.port, token=args.token,
                       state_path=args.state)
     # Port on stdout so launchers with --port 0 can discover it.

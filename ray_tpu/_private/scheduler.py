@@ -352,11 +352,12 @@ class LocalScheduler:
                 worker_mod._task_context.task_name = spec.name
                 try:
                     renv = spec.runtime_env
-                    if renv is not None and renv.get("pip"):
+                    if renv is not None and (renv.get("pip")
+                                             or renv.get("uv")):
                         # Thread-plane workers share the driver
-                        # interpreter; a pip env cannot apply here.
+                        # interpreter; a venv-backed env cannot apply.
                         raise RuntimeEnvSetupError(
-                            "pip runtime envs need process workers "
+                            "pip/uv runtime envs need process workers "
                             "(worker_mode='process', the default)")
                     if renv is not None:
                         with renv.stage().applied():

@@ -20,6 +20,11 @@ from typing import Dict, List, Tuple
 class Filesystem:
     """Minimal surface the Data readers/writers need."""
 
+    # When True, resolve_filesystem hands this fs the FULL scheme-
+    # qualified path, so two schemes backed by the same store class
+    # cannot alias each other's keys.
+    keeps_scheme = False
+
     def open(self, path: str, mode: str = "rb"):
         raise NotImplementedError
 
@@ -75,6 +80,7 @@ class MemoryFilesystem(Filesystem):
     other nodes, via the head KV) see files the driver wrote; a plain
     process-local dict otherwise."""
 
+    keeps_scheme = True  # keys stay scheme-qualified in the shared store
     _KV_PREFIX = b"memfs|"
     _store: Dict[str, bytes] = {}  # no-runtime fallback
     _lock = threading.Lock()
@@ -195,9 +201,7 @@ def resolve_filesystem(path: str) -> Tuple[Filesystem, str]:
     scheme, _, rest = path.partition("://")
     fs = _REGISTRY.get(scheme)
     if fs is not None:
-        if scheme == "memory":
-            return fs, scheme + "://" + rest  # keep keys scheme-qualified
-        return fs, rest
+        return (fs, path) if fs.keeps_scheme else (fs, rest)
     try:
         import fsspec
 

@@ -23,6 +23,7 @@ class AlgorithmConfig:
 
     def __init__(self, algo: str = "PPO"):
         from ray_tpu.rl.dqn import DQNConfig
+        from ray_tpu.rl.impala import IMPALAConfig
 
         self.algo = algo
         self.env_name = "CartPole-v1"
@@ -30,7 +31,10 @@ class AlgorithmConfig:
         self.num_env_runners = 0
         self.num_envs_per_runner = 64
         self.rollout_len = 128
-        self.train_config = (DQNConfig() if algo == "DQN" else PPOConfig())
+        self.train_config = (
+            DQNConfig() if algo == "DQN"
+            else IMPALAConfig() if algo == "IMPALA"
+            else PPOConfig())
         self.seed = 0
 
     def environment(self, env: str = None, *, env_factory=None
@@ -60,7 +64,18 @@ class AlgorithmConfig:
         self.seed = seed
         return self
 
-    def build(self) -> "Algorithm":
+    def build(self):
+        if self.algo == "IMPALA":
+            from ray_tpu.rl.impala import IMPALA
+
+            factory = self.env_factory or _ENVS.get(self.env_name)
+            if factory is None:
+                raise ValueError(f"unknown env {self.env_name!r}")
+            return IMPALA(
+                factory(), self.train_config,
+                num_runners=max(self.num_env_runners, 1),
+                num_envs=self.num_envs_per_runner,
+                rollout_len=self.rollout_len, seed=self.seed)
         return Algorithm(self)
 
     # reference alias

@@ -156,6 +156,7 @@ class IMPALA:
             ref2 = self._runners[idx].sample.remote(host_params)
             inflight[ref2] = idx
             submit_ts[ref2] = time.perf_counter()
+            pre_update = [r for r, ts in submit_ts.items()]
             t0 = time.perf_counter()
             rollout = jax.tree.map(jnp.asarray, rollout)
             self.params, self._opt_state, loss = self._update(
@@ -163,11 +164,16 @@ class IMPALA:
             loss = float(loss)  # blocks: honest update timing
             t1 = time.perf_counter()
             update_wall += t1 - t0
-            # Overlap measurement: samples submitted BEFORE this update
-            # started and still in flight when it finished were being
-            # collected for its entire duration.
-            if any(ts <= t0 for ts in submit_ts.values()):
-                overlap_s += t1 - t0
+            # Overlap measurement (falsifiable, not tautological): a
+            # sample submitted before the update that is STILL not ready
+            # after it was genuinely being collected for the update's
+            # whole duration. Serialized collection (idle runners during
+            # updates) earns zero credit here.
+            if pre_update:
+                _, not_ready = ray_tpu.wait(
+                    pre_update, num_returns=len(pre_update), timeout=0)
+                if not_ready:
+                    overlap_s += t1 - t0
             losses.append(loss)
             done_rates.append(float(jnp.mean(rollout.dones)))
             updates += 1

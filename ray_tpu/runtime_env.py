@@ -17,9 +17,12 @@ Supported fields:
   reinstall. Builds are lazy (first lease that needs the env) and cached
   across sessions under ``~/.cache/ray_tpu/runtime_envs`` (override:
   ``RAY_TPU_RUNTIME_ENV_CACHE``).
+- ``uv`` — same semantics as ``pip`` but the venv is created and
+  populated by the ``uv`` tool (much faster resolution/installs);
+  requires ``uv`` on PATH.
 
-``conda``/``container``/``uv`` envs are declared but rejected loudly
-rather than silently ignored.
+``conda``/``container`` envs are declared but rejected loudly rather
+than silently ignored.
 """
 
 from __future__ import annotations
@@ -37,7 +40,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
-_UNSUPPORTED = ("conda", "container", "uv")
+_UNSUPPORTED = ("conda", "container")
 _apply_lock = threading.Lock()
 
 
@@ -47,9 +50,11 @@ def _cache_root() -> str:
         os.path.expanduser("~/.cache/ray_tpu/runtime_envs"))
 
 
-def pip_env_key(pip: List[str]) -> str:
-    """Content address of a pip requirement set (+ interpreter version)."""
+def pip_env_key(pip: List[str], builder: str = "pip") -> str:
+    """Content address of a requirement set (+ interpreter version +
+    builder tool)."""
     h = hashlib.sha256()
+    h.update(builder.encode())
     h.update(sys.version.split()[0].encode())
     for spec in sorted(pip):
         # Local paths hash by content so a rebuilt wheel busts the cache.
@@ -61,12 +66,13 @@ def pip_env_key(pip: List[str]) -> str:
     return h.hexdigest()[:16]
 
 
-def ensure_pip_env(pip: List[str]) -> str:
+def ensure_pip_env(pip: List[str], builder: str = "pip") -> str:
     """Build (or reuse) the venv for this requirement set; returns its
-    python executable. Concurrent builders coordinate via flock."""
+    python executable. Concurrent builders coordinate via flock. The
+    ``uv`` builder creates/populates the venv with the uv tool."""
     import fcntl
 
-    key = pip_env_key(pip)
+    key = pip_env_key(pip, builder)
     root = os.path.join(_cache_root(), key)
     python = os.path.join(root, "bin", "python")
     ready = os.path.join(root, ".ready")
@@ -81,9 +87,19 @@ def ensure_pip_env(pip: List[str]) -> str:
                 return python
             if os.path.exists(root):
                 shutil.rmtree(root, ignore_errors=True)
-            subprocess.run(
-                [sys.executable, "-m", "venv", root],
-                check=True, capture_output=True, timeout=300)
+            if builder == "uv":
+                uv = shutil.which("uv")
+                if uv is None:
+                    raise RuntimeEnvSetupError(
+                        "runtime_env 'uv' requested but the uv tool is "
+                        "not on PATH")
+                subprocess.run(
+                    [uv, "venv", "--python", sys.executable, root],
+                    check=True, capture_output=True, timeout=300)
+            else:
+                subprocess.run(
+                    [sys.executable, "-m", "venv", root],
+                    check=True, capture_output=True, timeout=300)
             # Inherit the driver env's packages, venv's own dir first.
             site_dir = subprocess.run(
                 [python, "-c",
@@ -93,9 +109,15 @@ def ensure_pip_env(pip: List[str]) -> str:
             parent_site = sysconfig.get_paths()["purelib"]
             with open(os.path.join(site_dir, "_parent_site.pth"), "w") as f:
                 f.write(parent_site + "\n")
-            subprocess.run(
-                [python, "-m", "pip", "install", "--quiet", *pip],
-                check=True, capture_output=True, timeout=600)
+            if builder == "uv":
+                subprocess.run(
+                    [shutil.which("uv"), "pip", "install", "--quiet",
+                     "--python", python, *pip],
+                    check=True, capture_output=True, timeout=600)
+            else:
+                subprocess.run(
+                    [python, "-m", "pip", "install", "--quiet", *pip],
+                    check=True, capture_output=True, timeout=600)
             with open(ready, "w") as f:
                 f.write("\n".join(sorted(pip)))
             return python
@@ -119,35 +141,51 @@ class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
-                 pip: Optional[List[str]] = None, **kwargs):
+                 pip: Optional[List[str]] = None,
+                 uv: Optional[List[str]] = None, **kwargs):
         bad = [k for k in kwargs if k in _UNSUPPORTED]
         if bad:
             raise ValueError(
                 f"runtime_env features {bad} are not supported by this "
                 f"runtime (supported: env_vars, working_dir, py_modules, "
-                f"pip)")
+                f"pip, uv)")
+        if pip and uv:
+            raise ValueError(
+                "runtime_env accepts 'pip' OR 'uv', not both — they "
+                "describe the same venv with different builders")
         super().__init__(
             env_vars=env_vars or {}, working_dir=working_dir,
-            py_modules=py_modules or [], pip=list(pip or []), **kwargs)
+            py_modules=py_modules or [], pip=list(pip or []),
+            uv=list(uv or []), **kwargs)
         self._staged_dir: Optional[str] = None
         self._env_key: Optional[str] = None
 
+    def _specs(self):
+        """(requirement specs, builder) for the venv-backed fields."""
+        if self.get("uv"):
+            return self["uv"], "uv"
+        if self.get("pip"):
+            return self["pip"], "pip"
+        return None, None
+
     def env_key(self) -> Optional[str]:
         """Worker-binding key: tasks sharing it may share a worker
-        process. Only pip envs change the interpreter; the other fields
-        apply per-execution inside any worker."""
-        if not self.get("pip"):
+        process. Only pip/uv envs change the interpreter; the other
+        fields apply per-execution inside any worker."""
+        specs, builder = self._specs()
+        if specs is None:
             return None
         if self._env_key is None:  # hashing local wheels reads them; cache
-            self._env_key = pip_env_key(self["pip"])
+            self._env_key = pip_env_key(specs, builder)
         return self._env_key
 
     def python_executable(self) -> Optional[str]:
         """Build (lazily) and return this env's interpreter, or None when
         the default interpreter serves."""
-        if not self.get("pip"):
+        specs, builder = self._specs()
+        if specs is None:
             return None
-        return ensure_pip_env(self["pip"])
+        return ensure_pip_env(specs, builder)
 
     def stage(self) -> "RuntimeEnv":
         """Copy working_dir into a session dir (content-addressed caching is

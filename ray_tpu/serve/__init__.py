@@ -5,7 +5,9 @@ actors; a Router picks replicas per request with power-of-two-choices on
 queue length; DeploymentHandles compose deployments (async futures);
 @serve.batch dynamically batches — the TPU-relevant feature, since batching
 is what keeps the MXU fed at serving time. HTTP ingress is a thin stdlib
-http.server proxy (the reference uses uvicorn; no new deps here).
+http.server proxy (the reference uses uvicorn; no new deps here); gRPC
+ingress serves ANY `/<pkg.Service>/<Method>` through generic unary
+handlers with no protoc step (`serve/grpc.py`).
 """
 
 from ray_tpu.serve.api import (
@@ -28,6 +30,7 @@ from ray_tpu.serve.handle import (
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.grpc import start_grpc_proxy, stop_grpc_proxy
 
 __all__ = [
     "Application",
@@ -45,5 +48,7 @@ __all__ = [
     "run",
     "shutdown",
     "start",
+    "start_grpc_proxy",
     "status",
+    "stop_grpc_proxy",
 ]

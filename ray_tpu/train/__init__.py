@@ -27,6 +27,8 @@ from ray_tpu.train.session import (
     report,
 )
 from ray_tpu.train.storage import CheckpointStore
+from ray_tpu.train import torch  # noqa: F401 — ray_tpu.train.torch.*
+from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
 
 # Reference-name alias: users arriving from the reference find the same
@@ -47,6 +49,7 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "TorchTrainer",
     "TrainingFailedError",
     "get_checkpoint",
     "get_context",

@@ -40,7 +40,9 @@ def upload_dir(local_dir: str, dest_uri: str) -> str:
             fs.makedirs(key.rsplit("/", 1)[0])
             with open(os.path.join(root, f), "rb") as src, \
                     fs.open(key, "wb") as out:
-                out.write(src.read())
+                import shutil
+
+                shutil.copyfileobj(src, out)  # streamed, not slurped
     return dest_uri
 
 
@@ -57,7 +59,9 @@ def download_dir(src_uri: str, local_dir: Optional[str] = None) -> str:
         target = os.path.join(local_dir, *rel.split("/"))
         os.makedirs(os.path.dirname(target), exist_ok=True)
         with fs.open(key, "rb") as inp, open(target, "wb") as out:
-            out.write(inp.read())
+            import shutil
+
+            shutil.copyfileobj(inp, out)
     return local_dir
 
 

@@ -139,19 +139,21 @@ class JaxTrainer:
 
         from ray_tpu.data.filesystem import resolve_filesystem
 
-        state = cloudpickle.dumps({
-            "loop": self._loop,
-            "loop_config": self._loop_config,
-            "scaling": self._scaling,
-            "run_config": self._run_config,
-        }, protocol=5)
         try:
+            # Dump INSIDE the guard: an unpicklable loop must not fail
+            # fit() — restore() then requires an explicit loop argument.
+            state = cloudpickle.dumps({
+                "loop": self._loop,
+                "loop_config": self._loop_config,
+                "scaling": self._scaling,
+                "run_config": self._run_config,
+            }, protocol=5)
             fs, p = resolve_filesystem(root)
             fs.makedirs(p)
             with fs.open(p.rstrip("/") + "/trainer.pkl", "wb") as f:
                 f.write(state)
-        except Exception:  # noqa: BLE001 — unpicklable loop: restore()
-            pass  # falls back to requiring an explicit loop argument
+        except Exception:  # noqa: BLE001 — unpicklable loop / fs error
+            pass
 
     # -------------------------------------------------------------- attempt
     def _run_attempt(self, restore_from: Optional[Checkpoint]):

@@ -7,6 +7,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
+
+pytestmark = pytest.mark.slow  # full-cluster / env-build suite
 import ray_tpu.data as rd
 
 

@@ -179,6 +179,7 @@ ray_tpu.shutdown()
 """
 
 
+@pytest.mark.slow
 def test_head_restart_recovers(tmp_path):
     """GCS fault tolerance: kill -9 the head mid-session, restart it on
     the same port with the same state log, and a surviving driver's KV
@@ -240,6 +241,7 @@ def test_head_restart_recovers(tmp_path):
         ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_head_log_compaction(tmp_path):
     """Past the record threshold the append-log collapses to one
     snapshot record: the file stays proportional to LIVE state, and a
@@ -295,6 +297,67 @@ def test_head_log_compaction(tmp_path):
             head2.wait(timeout=5)
     finally:
         ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_head_standby_failover(tmp_path):
+    """Replicated-head story: a warm standby shares the primary's state
+    log; when the primary is SIGKILLed the standby promotes and clients
+    configured with "primary,standby" fail over and read the SAME state
+    (GCS-FT multi-head analogue)."""
+    import socket
+
+    token = "feedfacecafe0123"
+    state = str(tmp_path / "shared_state.log")
+    env = dict(os.environ)
+    env["RAY_TPU_CLUSTER_TOKEN"] = token
+    env["RAY_TPU_HEAD_CLIENT_TIMEOUT_S"] = "3.0"
+    os.environ["RAY_TPU_CLUSTER_TOKEN"] = token
+
+    with socket.socket() as s:  # reserve a standby port
+        s.bind(("127.0.0.1", 0))
+        standby_port = s.getsockname()[1]
+
+    primary = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", "0", "--state", state, "--token", token],
+        stdout=subprocess.PIPE, text=True, env=env)
+    address = primary.stdout.readline().strip().rsplit(" ", 1)[-1]
+    standby = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", str(standby_port), "--state", state,
+         "--token", token, "--standby-of", address],
+        stdout=subprocess.PIPE, text=True, env=env)
+    assert "standing by" in standby.stdout.readline()
+    ray_tpu.shutdown()
+    try:
+        worker = ray_tpu.init(
+            num_cpus=1, worker_mode="thread",
+            address=f"{address},127.0.0.1:{standby_port}",
+            ignore_reinit_error=True)
+        worker.kv_put(b"fo/key", b"survives")
+        primary.kill()
+        primary.wait(timeout=5)
+        # Standby promotes after ~3 missed probes; the client's next
+        # dials fail over to it and the shared log serves the state.
+        deadline = time.time() + 40
+        value = None
+        while time.time() < deadline:
+            try:
+                value = worker.kv_get(b"fo/key")
+                if value is not None:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert value == b"survives"
+        assert worker.head_client.address[1] == standby_port
+    finally:
+        ray_tpu.shutdown()
+        for p in (standby, primary):
+            p.kill()
+            p.wait(timeout=5)
+        os.environ.pop("RAY_TPU_CLUSTER_TOKEN", None)
 
 
 def test_head_client_close_frees_data_plane(head_proc):

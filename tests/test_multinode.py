@@ -12,6 +12,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # full-cluster / env-build suite
+
 
 def _spawn_env():
     env = dict(os.environ)

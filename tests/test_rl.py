@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile/learning-heavy; default keeps test_parallel + test_rl_async coverage
+
 from ray_tpu.rl import Algorithm, AlgorithmConfig, CartPole, EnvRunner
 from ray_tpu.rl.ppo import PPOLearner, gae_advantages
 

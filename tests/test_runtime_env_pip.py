@@ -14,6 +14,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # full-cluster / env-build suite
+
 
 def _make_wheel(tmp_path, name="graft_testpkg", version="1.0", value=41):
     """A minimal pure-python wheel, built by hand (no network, no
@@ -110,6 +112,41 @@ def test_task_runs_inside_pip_env(tmp_path, env_cache, ray_start_regular):
         return "leaked"
 
     assert ray_tpu.get(plain.remote(), timeout=60) == "isolated"
+
+
+def test_uv_env_builds_and_runs_task(tmp_path, env_cache,
+                                     ray_start_regular):
+    """The 'uv' runtime env: same venv semantics as pip, built by the
+    uv tool — a task imports a package only its env installed."""
+    import shutil as _shutil
+
+    if _shutil.which("uv") is None:
+        pytest.skip("uv not on PATH")
+    whl = _make_wheel(tmp_path, name="graft_uvpkg", value=77)
+
+    @ray_tpu.remote(runtime_env={"uv": [whl]})
+    def uses_pkg():
+        import graft_uvpkg
+
+        return graft_uvpkg.VALUE, sys.executable
+
+    value, exe = ray_tpu.get(uses_pkg.remote(), timeout=120)
+    assert value == 77
+    assert str(env_cache) in exe
+
+
+def test_pip_and_uv_conflict_rejected():
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    with pytest.raises(ValueError, match="not both"):
+        RuntimeEnv(pip=["x"], uv=["y"])
+
+
+def test_conda_still_rejected():
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    with pytest.raises(ValueError, match="not supported"):
+        RuntimeEnv(conda={"dependencies": ["x"]})
 
 
 def test_env_vars_apply_in_worker(ray_start_regular):

@@ -9,6 +9,8 @@ import urllib.request
 import pytest
 
 import ray_tpu
+
+pytestmark = pytest.mark.slow  # full-cluster / env-build suite
 from ray_tpu import serve
 
 

@@ -1,0 +1,62 @@
+"""gRPC ingress tests (reference model: serve gRPC proxy tests —
+generic unary routing to deployments; SURVEY.md §2.6 serve row)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture
+def runtime():
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+    yield
+    serve.stop_grpc_proxy()
+    serve.shutdown()
+
+
+def _call(channel, method, payload, metadata=()):
+    stub = channel.unary_unary(
+        method,
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b)
+    return stub(json.dumps(payload).encode(), metadata=metadata,
+                timeout=60)
+
+
+def test_grpc_ingress_routes_to_deployment(runtime):
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __call__(self, a, b):
+            return a + b
+
+        def scale(self, x, k=2):
+            return x * k
+
+    serve.run(Adder.bind())
+    proxy = serve.start_grpc_proxy(port=0)
+    with grpc.insecure_channel(f"127.0.0.1:{proxy.port}") as ch:
+        # Service name's last segment selects the deployment.
+        out = _call(ch, "/user.Adder/Call", {"args": [3, 4]})
+        assert json.loads(out)["result"] == 7
+        # Named method + kwargs.
+        out = _call(ch, "/user.Adder/scale", {"args": [5],
+                                              "kwargs": {"k": 10}})
+        assert json.loads(out)["result"] == 50
+        # Metadata 'application' overrides the service-name route.
+        out = _call(ch, "/anything.Ignored/Call", {"args": [1, 1]},
+                    metadata=(("application", "Adder"),))
+        assert json.loads(out)["result"] == 2
+
+
+def test_grpc_ingress_unknown_deployment_is_not_found(runtime):
+    proxy = serve.start_grpc_proxy(port=0)
+    with grpc.insecure_channel(f"127.0.0.1:{proxy.port}") as ch:
+        with pytest.raises(grpc.RpcError) as err:
+            _call(ch, "/user.Nope/Call", {})
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND

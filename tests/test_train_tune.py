@@ -8,6 +8,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
+
+pytestmark = pytest.mark.slow  # full-cluster / env-build suite
 from ray_tpu import tune
 from ray_tpu.train import (
     Checkpoint,

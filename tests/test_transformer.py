@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # compile/learning-heavy; default keeps test_parallel + test_rl_async coverage
+
 from ray_tpu.models import (
     TransformerConfig,
     init_params,
