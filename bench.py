@@ -69,40 +69,51 @@ def _run_probe(probe, n, extra=(), timeout=900):
 
 
 def _marginal_times(probe, n_small, n_big, repeats, extra=()):
-    """Per-iteration marginal times: Theil-Sen slopes over `repeats`
-    fresh-process walls at each of the two sizes. The median of ALL
-    cross-pair slopes is robust to a single slow process (tunnel
-    reconnect, compile-cache miss), which a plain per-pair difference
-    is not."""
+    """Per-iteration marginal times as (cross_slopes, paired_slopes).
+
+    The VALUE comes from the Theil-Sen median of ALL cross-pair slopes
+    — robust to a single slow process (tunnel reconnect, compile-cache
+    miss). The SPREAD comes from the per-repeat PAIRED slopes
+    (small_i, big_i measured back-to-back): pairing cancels slow drift
+    between repeats, so the reported IQR reflects estimator stability
+    instead of the cross-product of every wall against every other."""
     _run_probe(probe, 2, extra)  # warm the backend compile cache, untimed
     small, big = [], []
     for _ in range(repeats):
         small.append(_run_probe(probe, n_small, extra)["wall_s"])
         big.append(_run_probe(probe, n_big, extra)["wall_s"])
     span = n_big - n_small
-    return sorted((wb - ws) / span for ws in small for wb in big)
+    cross = sorted((wb - ws) / span for ws in small for wb in big)
+    paired = sorted((b - s) / span for s, b in zip(small, big))
+    return cross, paired
 
 
-def _rate_stats(margs, units):
-    """(rate_med, rate_iqr, n_dropped) from per-iteration marginal times.
+def _rate_stats(cross, paired, units):
+    """(rate_med, rate_iqr, n_dropped) from marginal-time slopes.
 
-    A single anomalous wall (tunnel reconnect, one-off stall) poisons
-    `repeats` of the cross-pair slopes; a near-zero slope then maps to a
-    near-infinite rate and detonates the IQR (the round-4 artifact:
-    fanout IQR 29M on a 3.3M median). Slopes outside [med/4, 4*med] are
-    physically impossible marginals on this hardware — drop them before
-    converting to rates so the reported spread reflects real run-to-run
-    variance, not reciprocal blow-up."""
-    med = statistics.median(margs)
-    if med > 0:
-        kept = [m for m in margs if m > 0 and med / 4 <= m <= med * 4]
-    else:
-        kept = [m for m in margs if m > 0]  # noise-dominated run
-    if not kept:
-        return 0.0, 0.0, len(margs)  # no usable slope at all
-    rates = sorted(units / m for m in kept)
-    rate_med, rate_iqr = _median_iqr(rates)
-    return rate_med, rate_iqr, len(margs) - len(kept)
+    Median rate: Theil-Sen over the cross-pair slopes. Spread: IQR over
+    the per-repeat PAIRED rates. Both trim slopes outside [med/4,
+    4*med] first — a single anomalous wall (tunnel reconnect) otherwise
+    maps a near-zero slope to a near-infinite rate and detonates the
+    IQR (the round-4 artifact: fanout IQR 29M on a 3.3M median)."""
+    med = statistics.median(cross)
+    if med <= 0:
+        kept = [m for m in cross if m > 0]
+        if not kept:
+            return 0.0, 0.0, len(cross)
+        med = statistics.median(kept)
+
+    def _trim(slopes):
+        return [m for m in slopes if m > 0 and med / 4 <= m <= med * 4]
+
+    trimmed_cross, trimmed_paired = _trim(cross), _trim(paired)
+    kept_cross = trimmed_cross or [med]
+    kept_paired = trimmed_paired or kept_cross
+    rate_med = units / statistics.median(kept_cross)
+    _, rate_iqr = _median_iqr(sorted(units / m for m in kept_paired))
+    dropped = (len(cross) - len(trimmed_cross)) + \
+        (len(paired) - len(trimmed_paired))
+    return rate_med, rate_iqr, dropped
 
 
 def _median_iqr(vals):
@@ -157,9 +168,9 @@ def bench_chain(n_tasks=1000, repeats=9):
     """Config #1: single-node no-op task chain. Marginal-timed (see the
     honest-timing note at _run_probe): each repeat is a fresh-process pair
     of 2000 vs 50000 data-dependent executions ending in one readback."""
-    margs = _marginal_times("chain", 2000, 50000, repeats)
-    rate_med, rate_iqr, dropped = _rate_stats(margs, n_tasks)
-    per_exec = statistics.median(margs)
+    cross, paired = _marginal_times("chain", 2000, 50000, repeats)
+    rate_med, rate_iqr, dropped = _rate_stats(cross, paired, n_tasks)
+    per_exec = statistics.median(cross)
     # Synchronous end-to-end latency: execute + blocking get, measured in
     # the tunnel's post-readback synchronous mode (a separate probe).
     sync = _run_probe("chain_sync", 10)
@@ -187,11 +198,14 @@ def bench_chain(n_tasks=1000, repeats=9):
 
 def bench_fanout(width=10_000, repeats=7):
     """Config #2: wide fan-out -> fan-in reduce. Marginal-timed like
-    bench_chain (fresh-process pairs of 200 vs 1800 dependent execs)."""
-    margs = _marginal_times("fanout", 200, 2600, repeats)
+    bench_chain (fresh-process pairs of 200 vs 9000 dependent execs)."""
+    # Span sized so the ~±0.5 s wall noise is <2% of the marginal term
+    # (9000 execs ≈ 40 s): the IQR target (<20%) is unreachable on a
+    # span the noise can swamp.
+    cross, paired = _marginal_times("fanout", 200, 9000, repeats)
     n_total = 13334  # width + ceil-div-4 reduce tree; asserted in probe
-    rate_med, rate_iqr, dropped = _rate_stats(margs, n_total)
-    per_exec = statistics.median(margs)
+    rate_med, rate_iqr, dropped = _rate_stats(cross, paired, n_total)
+    per_exec = statistics.median(cross)
     return {
         "suite": "fanout_10k",
         "tasks_per_sec": rate_med,
@@ -651,9 +665,9 @@ def bench_rl_rollout(repeats=6):
     _run_probe)."""
     try:
         num_envs, rollout_len = 64, 512
-        margs = _marginal_times("rl", 25, 600, repeats)
+        cross, paired = _marginal_times("rl", 25, 3500, repeats)
         steps = num_envs * rollout_len
-        rate_med, rate_iqr, dropped = _rate_stats(margs, steps)
+        rate_med, rate_iqr, dropped = _rate_stats(cross, paired, steps)
         return {
             "suite": "rl_rollout",
             "env_steps_per_sec": rate_med,

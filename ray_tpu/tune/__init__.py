@@ -24,15 +24,25 @@ from ray_tpu.tune.tuner import (
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
 )
 
 __all__ = [
     "ASHAScheduler",
+    "BasicVariantGenerator",
     "FIFOScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "Searcher",
+    "TPESearcher",
     "ResultGrid",
     "TrialResult",
     "TuneConfig",

@@ -60,6 +60,40 @@ class ASHAScheduler:
         return CONTINUE
 
 
+class HyperBandScheduler:
+    """HyperBand proper (async formulation, reference:
+    schedulers/async_hyperband.py): `brackets` parallel ASHA instances
+    with geometrically staggered grace periods — late-bracket trials
+    get longer minimum budgets, hedging against slow starters that
+    aggressive early halving would kill. Trials round-robin across
+    brackets at registration. BOHB = this scheduler + TPESearcher as
+    the search_alg."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4, brackets: int = 3):
+        self._brackets: List[ASHAScheduler] = []
+        for s in range(max(int(brackets), 1)):
+            grace = min(grace_period * reduction_factor ** s, max_t)
+            self._brackets.append(ASHAScheduler(
+                metric, mode, max_t=max_t, grace_period=grace,
+                reduction_factor=reduction_factor))
+        self._of: Dict[str, ASHAScheduler] = {}
+        self._rr = 0
+
+    def register(self, trial_id: str, config: Dict[str, Any]):
+        self._of[trial_id] = self._brackets[self._rr
+                                            % len(self._brackets)]
+        self._rr += 1
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        bracket = self._of.get(trial_id)
+        if bracket is None:  # unregistered trial: assign round-robin
+            self.register(trial_id, {})
+            bracket = self._of[trial_id]
+        return bracket.on_result(trial_id, result)
+
+
 class MedianStoppingRule:
     """Stop a trial whose best result is below the median of running
     averages of completed peers at the same step."""

@@ -50,12 +50,15 @@ def _stop_key(run: str, tid: str) -> bytes:
     return f"tune|{run}|stop|{tid}".encode()
 
 
-def report(metrics: Dict[str, Any],
-           checkpoint: Optional[Checkpoint] = None) -> None:
+def report(metrics: Optional[Dict[str, Any]] = None,
+           checkpoint: Optional[Checkpoint] = None, **kw) -> None:
     """Inside a trainable: stream metrics; raises to unwind when the
-    scheduler has stopped this trial. Blocks until the controller acks."""
+    scheduler has stopped this trial. Blocks until the controller acks.
+    Accepts a metrics dict (new API) or keyword metrics
+    (``tune.report(score=1.0)`` — legacy reference parity)."""
     from ray_tpu._private.worker import auto_init
 
+    metrics = {**(dict(metrics) if metrics else {}), **kw}
     sess = getattr(_local, "tune_session", None)
     if sess is None:
         raise RuntimeError("tune.report() called outside a trial")
@@ -100,6 +103,11 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    # Pluggable search algorithm (tune.search.Searcher): suggests each
+    # trial's config at SUBMIT time, informed by completed trials —
+    # model-based searchers (TPESearcher; external optimizer adapters)
+    # plug in here. None keeps the grid/random variant expansion.
+    search_alg: Any = None
     seed: int = 0
 
 
@@ -165,13 +173,28 @@ class Tuner:
         ray_tpu.init(ignore_reinit_error=True)
         tc = self._tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = generate_variants(
-            self._param_space, tc.num_samples, seed=tc.seed)
-        trials = {
-            f"trial_{i:05d}": TrialResult(f"trial_{i:05d}", cfg)
-            for i, cfg in enumerate(variants)
-        }
-        if hasattr(scheduler, "register"):
+        search_alg = tc.search_alg
+        if search_alg is not None:
+            search_alg.set_search_space(self._param_space)
+            # Configs are suggested lazily at submit time (so completed
+            # trials inform later suggestions); ids fixed up front. A
+            # searcher that expands the space itself (grid) reports its
+            # own trial count so variants are never truncated.
+            n_trials = tc.num_samples
+            if hasattr(search_alg, "total_trials"):
+                n_trials = int(search_alg.total_trials(tc.num_samples))
+            trials = {
+                f"trial_{i:05d}": TrialResult(f"trial_{i:05d}", {})
+                for i in range(n_trials)
+            }
+        else:
+            variants = generate_variants(
+                self._param_space, tc.num_samples, seed=tc.seed)
+            trials = {
+                f"trial_{i:05d}": TrialResult(f"trial_{i:05d}", cfg)
+                for i, cfg in enumerate(variants)
+            }
+        if hasattr(scheduler, "register") and search_alg is None:
             for tid, tr in trials.items():
                 scheduler.register(tid, tr.config)
 
@@ -204,7 +227,10 @@ class Tuner:
                     trials[tid].metrics_history.append(metrics)
                     if ckpt is not None:
                         trials[tid].checkpoint = ckpt
-                    if scheduler.on_result(tid, metrics) == STOP:
+                    # Checkpoint-only reports carry no metric: skip the
+                    # scheduling decision (ASHA et al. index the metric).
+                    if metrics and scheduler.on_result(
+                            tid, metrics) == STOP:
                         worker.kv_put(_stop_key(run_id, tid), b"1")
                     if hasattr(scheduler, "maybe_exploit"):
                         new_cfg = scheduler.maybe_exploit(tid)
@@ -219,6 +245,14 @@ class Tuner:
         while pending or running:
             while pending and len(running) < tc.max_concurrent_trials:
                 tid, trial = pending.pop(0)
+                if search_alg is not None:
+                    cfg = search_alg.suggest(tid)
+                    if cfg is None:  # searcher exhausted its space
+                        final_status[tid] = "SKIPPED"
+                        continue
+                    trial.config = dict(cfg)
+                    if hasattr(scheduler, "register"):
+                        scheduler.register(tid, trial.config)
                 ref = run_trial.remote(tid, trial.config)
                 running[ref] = tid
             _drain()
@@ -231,6 +265,12 @@ class Tuner:
                 except Exception as exc:  # noqa: BLE001 — trial failure
                     trials[tid].error = repr(exc)
                     final_status[tid] = "ERRORED"
+                if search_alg is not None:
+                    try:
+                        search_alg.on_trial_complete(
+                            tid, trials[tid].metrics)
+                    except Exception:  # noqa: BLE001 — searcher bug
+                        pass
         _drain()  # reports that raced with completion
         for key in worker.kv_keys(f"tune|{run_id}|".encode()):
             worker.kv_del(key)
