@@ -731,6 +731,11 @@ class LocalScheduler:
         with self._lock:
             return self._backlog
 
+    def num_running(self) -> int:
+        """Tasks currently EXECUTING (backlog minus these = queued)."""
+        with self._lock:
+            return len(self._running)
+
     def num_finished(self) -> int:
         with self._lock:
             return self._num_finished

@@ -256,6 +256,15 @@ class Worker:
             task_events=self.task_events,
             worker_pool=self.worker_pool, shm_store=self.shm_store,
         )
+        # Debug-mode host-plane sanitizer (RAY_TPU_SANITIZE=1): refcount
+        # underflow + channel protocol checks hook in at their sites;
+        # the stall watchdog needs the runtime handles.
+        self.sanitizer_watchdog = None
+        from ray_tpu.util import sanitizer as _sanitizer
+
+        if _sanitizer.enabled():
+            self.sanitizer_watchdog = _sanitizer.StallWatchdog(
+                self.scheduler, self.resource_pool)
         self.memory_monitor = None
         if (self.worker_pool is not None
                 and GlobalConfig.memory_monitor_threshold > 0):
@@ -481,6 +490,9 @@ class Worker:
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
             self.memory_monitor = None
+        if self.sanitizer_watchdog is not None:
+            self.sanitizer_watchdog.stop()
+            self.sanitizer_watchdog = None
         self.scheduler.shutdown()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()

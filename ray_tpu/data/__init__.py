@@ -35,9 +35,11 @@ from ray_tpu.data.read_api import (
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_tfrecords,
 )
 from ray_tpu.data.stats import DatasetStats
@@ -48,7 +50,7 @@ __all__ = [
     "MaterializedDataset", "Max", "Mean", "MemoryFilesystem", "Min",
     "ReadTask", "Std", "Sum", "from_arrow", "from_columns",
     "from_items", "from_numpy", "from_pandas", "range",
-    "read_binary_files", "read_csv", "read_datasource", "read_json",
-    "read_numpy", "read_parquet", "read_tfrecords",
-    "register_filesystem", "resolve_filesystem",
+    "read_binary_files", "read_csv", "read_datasource", "read_images",
+    "read_json", "read_numpy", "read_parquet", "read_sql",
+    "read_tfrecords", "register_filesystem", "resolve_filesystem",
 ]

@@ -307,6 +307,82 @@ def read_tfrecords(paths, **_opts) -> Dataset:
     return _from_read_tasks("ReadTFRecords", [make_task(f) for f in files])
 
 
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
+             **_opts) -> Dataset:
+    """Read a SQL query through any DBAPI-2 connection factory
+    (reference: ray.data.read_sql). The factory runs INSIDE each read
+    task (connections don't pickle); with parallelism > 1 the query is
+    sharded by ``rowid``-style modulo only when the caller embeds a
+    ``{shard}``/``{num_shards}`` placeholder, otherwise one task reads
+    the full result."""
+    sharded = "{shard}" in sql
+    n_tasks = parallelism if sharded else 1
+
+    def make_task(shard):
+        def task() -> List[Block]:
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                # Targeted replacement, NOT str.format: SQL legitimately
+                # contains other braces (json paths etc.), and a query
+                # with only {num_shards} must still substitute.
+                query = sql.replace("{shard}", str(shard)) \
+                    .replace("{num_shards}", str(n_tasks))
+                cur.execute(query)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            if not rows:
+                return [{}]
+            arrays = {c: np.asarray([r[i] for r in rows])
+                      for i, c in enumerate(cols)}
+            return [arrays]
+
+        return task
+
+    import builtins
+
+    return _from_read_tasks(
+        "ReadSQL", [make_task(s) for s in builtins.range(n_tasks)])
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                **_opts) -> Dataset:
+    """Read image files into an ``image`` column of HWC uint8 arrays
+    (reference: ray.data.read_images; decoding via PIL). ``size``
+    resizes to (width, height); images decode inside the read tasks.
+    Directory/glob expansion keeps only image extensions (a stray
+    README/.csv in the tree must not fail the read)."""
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp",
+            ".tif", ".tiff")
+    files = [f for f in _expand_paths(paths)
+             if f.lower().endswith(exts)]
+    if not files:
+        raise FileNotFoundError(
+            f"no image files ({'/'.join(exts)}) matched {paths}")
+
+    def make_task(f):
+        def task() -> List[Block]:
+            import io
+
+            from PIL import Image
+
+            with _open_path(f) as fh:
+                img = Image.open(io.BytesIO(fh.read())).convert(mode)
+            if size is not None:
+                img = img.resize(tuple(size))
+            arr = np.asarray(img)
+            col = np.empty(1, dtype=object)
+            col[0] = arr
+            return [{"image": col,
+                     "path": np.asarray([f], dtype=object)}]
+
+        return task
+
+    return _from_read_tasks("ReadImages", [make_task(f) for f in files])
+
+
 def read_datasource(datasource, *, parallelism: int = 8, **opts) -> Dataset:
     """Custom Datasource protocol: object with get_read_tasks(parallelism)
     returning callables -> List[Block] (reference Datasource parity)."""

@@ -1,0 +1,189 @@
+"""Host-plane runtime sanitizers (reference role: SURVEY §5.2 — the
+reference leans on TSan/ASan builds for its C++ runtime; a Python/XLA
+host plane cannot use those, so this is the equivalent DEBUG-mode
+checker family for the invariants races would break [unverified]).
+
+The device plane needs no race detection by construction: everything
+under ``jit`` is a data-race-free dataflow program. The host plane's
+correctness rests on a handful of protocol invariants, and this module
+checks them live when ``RAY_TPU_SANITIZE=1`` (or ``enable()``):
+
+- **Refcount sanity** (object store): ``local_refs``/``submitted_refs``
+  must never go negative — an underflow is a double-release race that
+  silently frees objects still in use.
+- **Channel protocol** (compiled-DAG channels): each reader must
+  observe versions in strict +1 succession — a skip is a lost payload
+  (torn write / double-bump race), a repeat is a double-read.
+- **Stall watchdog**: tasks stuck in the scheduler beyond a threshold
+  with idle capacity — the observable shape of a host-side deadlock —
+  are reported with their names.
+
+Violations raise ``SanitizerError`` by default (tests), or log when
+``RAY_TPU_SANITIZE_MODE=warn`` (long-lived clusters).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class SanitizerError(AssertionError):
+    """A host-plane invariant the sanitizer watches was violated."""
+
+
+_enabled: Optional[bool] = None
+_violations: List[str] = []
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("RAY_TPU_SANITIZE", "0") == "1"
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def violations() -> List[str]:
+    with _lock:
+        return list(_violations)
+
+
+def clear() -> None:
+    with _lock:
+        _violations.clear()
+    with channel_checker._lock:
+        channel_checker._last.clear()
+
+
+def report(kind: str, message: str, force_warn: bool = False) -> None:
+    full = f"[ray_tpu sanitizer] {kind}: {message}"
+    with _lock:
+        _violations.append(full)
+    if force_warn or os.environ.get(
+            "RAY_TPU_SANITIZE_MODE", "raise") == "warn":
+        print(full, file=sys.stderr, flush=True)
+    else:
+        raise SanitizerError(full)
+
+
+_channel_ids = threading.Lock()
+_channel_counter = [0]
+
+
+def new_channel_id() -> int:
+    """Stable unique channel token — id() reuse after GC would alias
+    a fresh channel onto a dead one's sequence state."""
+    with _channel_ids:
+        _channel_counter[0] += 1
+        return _channel_counter[0]
+
+
+# ---------------------------------------------------------------- refcounts
+def check_refcount(object_id, local_refs: int, submitted_refs: int) -> None:
+    """Called by the object store after every decrement (when enabled):
+    a negative count is a double-release — the race that frees objects
+    still referenced."""
+    if local_refs < 0 or submitted_refs < 0:
+        report(
+            "refcount-underflow",
+            f"object {object_id.hex()[:16]}… local_refs={local_refs} "
+            f"submitted_refs={submitted_refs} (double release)")
+
+
+# ----------------------------------------------------------------- channels
+class ChannelSequenceChecker:
+    """Per-(channel, reader) version-succession invariant: versions must
+    arrive as v+1, v+2, … — a gap is a lost payload, a repeat is a
+    double-read."""
+
+    def __init__(self):
+        self._last: Dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, channel_id: int, reader_id: int,
+                version: int) -> None:
+        key = (channel_id, reader_id)
+        with self._lock:
+            last = self._last.get(key, 0)
+            self._last[key] = version
+        if version == last:
+            report("channel-double-read",
+                   f"channel {channel_id:#x} reader {reader_id} observed "
+                   f"version {version} twice")
+        elif version != last + 1:
+            report("channel-version-gap",
+                   f"channel {channel_id:#x} reader {reader_id} jumped "
+                   f"{last} -> {version} (lost payload)")
+
+
+channel_checker = ChannelSequenceChecker()
+
+
+# ------------------------------------------------------------ stall watchdog
+class StallWatchdog:
+    """Background detector for the observable shape of a host deadlock:
+    the scheduler holds queued tasks beyond `threshold_s` while worker
+    capacity sits idle (nothing running). Reports task names."""
+
+    def __init__(self, scheduler, resource_pool,
+                 threshold_s: float = 30.0, period_s: float = 5.0):
+        self._scheduler = scheduler
+        self._pool = resource_pool
+        self.threshold_s = threshold_s
+        self._period = period_s
+        self._stalled_since: Optional[float] = None
+        self._finished_mark = -1
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ray_tpu_sanitizer_watch")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._period):
+            try:
+                self._check()
+            except Exception:  # noqa: BLE001 — watcher must not die
+                pass
+
+    def _check(self):
+        s = self._scheduler
+        backlog = s.backlog_size()
+        running = getattr(s, "num_running", lambda: 0)()
+        finished = getattr(s, "num_finished", lambda: 0)()
+        queued = backlog - running
+        avail = self._pool.available()
+        idle = avail.get("CPU", 0.0) >= 1.0
+        # The deadlock shape: tasks QUEUED (not merely long-running),
+        # nothing executing, capacity idle, and zero completions across
+        # the window. A long-running task (running > 0) or any progress
+        # resets the clock.
+        if queued > 0 and running == 0 and idle:
+            now = time.monotonic()
+            if self._stalled_since is None \
+                    or finished != self._finished_mark:
+                self._stalled_since = now
+                self._finished_mark = finished
+            elif now - self._stalled_since > self.threshold_s:
+                self._stalled_since = None
+                # force_warn: raising in our own daemon thread would
+                # only kill the watchdog, not surface the error.
+                report(
+                    "scheduler-stall",
+                    f"{queued} task(s) queued > {self.threshold_s}s "
+                    f"with nothing running and idle capacity {avail} — "
+                    f"possible host deadlock (dependency cycle or lost "
+                    f"completion)", force_warn=True)
+        else:
+            self._stalled_since = None
+
+    def stop(self):
+        self._stop.set()

@@ -185,6 +185,43 @@ def test_csv_gz_compression_inference(tmp_path):
     assert list(df["a"]) == [1, 2, 3]
 
 
+def test_read_sql_sqlite(tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pts (id INTEGER, v REAL)")
+    conn.executemany("INSERT INTO pts VALUES (?, ?)",
+                     [(i, i * 0.5) for i in range(40)])
+    conn.commit()
+    conn.close()
+
+    ds = rdata.read_sql("SELECT id, v FROM pts",
+                        lambda: sqlite3.connect(db))
+    df = ds.to_pandas().sort_values("id").reset_index(drop=True)
+    assert list(df["id"]) == list(range(40))
+    assert np.allclose(df["v"], np.arange(40) * 0.5)
+    # Sharded query via the {shard}/{num_shards} placeholders.
+    ds2 = rdata.read_sql(
+        "SELECT id, v FROM pts WHERE id % {num_shards} = {shard}",
+        lambda: sqlite3.connect(db), parallelism=4)
+    assert ds2.count() == 40
+
+
+def test_read_images(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    for i in range(3):
+        Image.new("RGB", (8 + i, 6), color=(i * 10, 0, 0)).save(
+            tmp_path / f"img{i}.png")
+    ds = rdata.read_images(str(tmp_path), size=(4, 4))
+    rows = list(ds.iter_rows())
+    assert len(rows) == 3
+    assert all(r["image"].shape == (4, 4, 3) for r in rows)
+    assert rows[0]["image"].dtype == np.uint8
+
+
 def test_custom_filesystem_registration():
     class Prefixed(rdata.MemoryFilesystem):
         pass
