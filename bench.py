@@ -91,11 +91,12 @@ def _marginal_times(probe, n_small, n_big, repeats, extra=()):
 def _rate_stats(cross, paired, units):
     """(rate_med, rate_iqr, n_dropped) from marginal-time slopes.
 
-    Median rate: Theil-Sen over the cross-pair slopes. Spread: IQR over
-    the per-repeat PAIRED rates. Both trim slopes outside [med/4,
-    4*med] first — a single anomalous wall (tunnel reconnect) otherwise
-    maps a near-zero slope to a near-infinite rate and detonates the
-    IQR (the round-4 artifact: fanout IQR 29M on a 3.3M median)."""
+    Median rate: Theil-Sen over the cross-pair slopes (trimmed to
+    [med/4, 4*med]). Spread: IQR over the per-repeat PAIRED rates,
+    trimmed tighter to [med/2, 2*med] — a single anomalous wall (tunnel
+    reconnect) otherwise maps a near-zero slope to a near-infinite rate
+    and detonates the IQR (the round-4 artifact: fanout IQR 29M on a
+    3.3M median). Dropped slopes are counted in the artifact."""
     med = statistics.median(cross)
     if med <= 0:
         kept = [m for m in cross if m > 0]
@@ -103,12 +104,20 @@ def _rate_stats(cross, paired, units):
             return 0.0, 0.0, len(cross)
         med = statistics.median(kept)
 
-    def _trim(slopes):
-        return [m for m in slopes if m > 0 and med / 4 <= m <= med * 4]
+    def _trim(slopes, k):
+        return [m for m in slopes if m > 0 and med / k <= m <= med * k]
 
-    trimmed_cross, trimmed_paired = _trim(cross), _trim(paired)
+    # Cross pairs keep a wide window (they only feed the robust median);
+    # the PAIRED spread uses a tight one — a paired slope 2x off the
+    # Theil-Sen median is an anomalous run (tunnel hiccup), and counting
+    # it as steady-state variance makes the IQR useless for regression
+    # detection. Dropped counts are reported.
+    trimmed_cross, trimmed_paired = _trim(cross, 4), _trim(paired, 2)
     kept_cross = trimmed_cross or [med]
-    kept_paired = trimmed_paired or kept_cross
+    # No surviving paired slope: report IQR 0 with the dropped count
+    # flagging the degraded estimate — falling back to the cross spread
+    # would resurrect the very artifact this split exists to kill.
+    kept_paired = trimmed_paired or [statistics.median(kept_cross)]
     rate_med = units / statistics.median(kept_cross)
     _, rate_iqr = _median_iqr(sorted(units / m for m in kept_paired))
     dropped = (len(cross) - len(trimmed_cross)) + \

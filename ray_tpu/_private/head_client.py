@@ -97,7 +97,15 @@ class HeadClient:
         self._event = self._dial("event")
         self._pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu_head_event")
-        self._serialized_cache: Dict[bytes, bytes] = {}  # chunked reads
+        # Chunked-read serialization cache: byte-capped LRU so one GB-
+        # scale pull doesn't re-serialize per 4MiB chunk, while many
+        # small pulls can't grow the owner's memory without bound.
+        from collections import OrderedDict as _OD
+
+        self._serialized_cache: "_OD[bytes, bytes]" = _OD()
+        self._serialized_cache_bytes = 0
+        self._serialized_cache_cap = 256 << 20
+        self._serialized_cache_lock = threading.Lock()
         # Relayed-call results pinned until pulled (bounded FIFO).
         from collections import OrderedDict
 
@@ -377,9 +385,11 @@ class HeadClient:
     def _serialized_bytes(self, oid_bin: bytes) -> bytes:
         """Serialized form of a locally-owned object, cached briefly so a
         chunked pull doesn't re-serialize per chunk."""
-        cached = self._serialized_cache.get(oid_bin)
-        if cached is not None:
-            return cached
+        with self._serialized_cache_lock:  # pool threads share the LRU
+            cached = self._serialized_cache.get(oid_bin)
+            if cached is not None:
+                self._serialized_cache.move_to_end(oid_bin)
+                return cached
         from ray_tpu._private import worker as worker_mod
         from ray_tpu._private.ids import ObjectID
 
@@ -388,9 +398,17 @@ class HeadClient:
             raise RuntimeError("driver runtime is down")
         serialized = w.store.get(ObjectID(oid_bin), timeout=30.0)
         raw = serialized.to_bytes()
-        if len(self._serialized_cache) > 4:
-            self._serialized_cache.clear()
-        self._serialized_cache[oid_bin] = raw
+        with self._serialized_cache_lock:
+            old = self._serialized_cache.get(oid_bin)
+            if old is not None:  # concurrent miss raced us: replace
+                self._serialized_cache_bytes -= len(old)
+            self._serialized_cache[oid_bin] = raw
+            self._serialized_cache_bytes += len(raw)
+            while (self._serialized_cache_bytes
+                   > self._serialized_cache_cap
+                   and len(self._serialized_cache) > 1):
+                _, evicted = self._serialized_cache.popitem(last=False)
+                self._serialized_cache_bytes -= len(evicted)
         return raw
 
     def _handle_event(self, event: tuple):
