@@ -1,0 +1,20 @@
+PYTHON ?= python
+
+.PHONY: test bench bench-control-plane bench-gate
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+bench:
+	$(PYTHON) bench.py --all
+
+# Host control-plane microbenchmark (non-compiled @remote path through
+# the real scheduler + head/transport): chain 1k, fan-out 10k, cluster
+# fan-out. Prints one JSON line.
+bench-control-plane:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite control_plane
+
+# Regression gate over committed BENCH_pr*.json records: fails when the
+# newest record regresses >20% vs the previous one.
+bench-gate:
+	$(PYTHON) scripts/check_bench.py

@@ -668,6 +668,53 @@ def bench_sharded():
         return {"suite": "sharded_dag_1k_tensor", "skipped": repr(e)}
 
 
+def bench_control_plane(repeats=5):
+    """Config #8: the HOST control plane — the default (non-compiled)
+    ``@ray_tpu.remote`` path: submit → scheduler dispatch → object
+    store, plus the real head-service/transport cluster path. This is
+    the plane the batched-RPC / zero-copy-framing / event-driven-
+    dispatch work targets; the compiled-DAG suites above bypass it
+    entirely. Marginal-timed via fresh-process probes (honest-timing
+    note at _run_probe; no device involved — tasks are host noops)."""
+    result = {"suite": "control_plane"}
+    cross, paired = _marginal_times("cp_chain", 200, 2000, repeats)
+    rate, iqr, dropped = _rate_stats(cross, paired, 1)
+    result["chain_1k"] = {
+        "tasks_per_sec": rate, "tasks_per_sec_iqr": iqr,
+        "outlier_slopes_dropped": dropped, "repeats": repeats,
+        "task_latency_us": statistics.median(cross) * 1e6,
+    }
+    cross, paired = _marginal_times("cp_fanout", 1000, 10000, repeats)
+    rate, iqr, dropped = _rate_stats(cross, paired, 1)
+    result["fanout_10k"] = {
+        "tasks_per_sec": rate, "tasks_per_sec_iqr": iqr,
+        "outlier_slopes_dropped": dropped, "repeats": repeats,
+        "task_latency_us": statistics.median(cross) * 1e6,
+    }
+    lat = _run_probe("cp_latency", 200)
+    result["sync_submit_get_p50_us"] = lat["p50_s"] * 1e6
+    result["sync_submit_get_p99_us"] = lat["p99_s"] * 1e6
+    try:
+        # Through the real head service + node daemon + framed
+        # transport: driver with zero local CPUs, every task crosses
+        # the wire (task_push batches out, task_done batches back,
+        # results pull peer-to-peer with windowed chunks).
+        cross, paired = _marginal_times(
+            "cp_cluster", 100, 1000, max(3, repeats - 2))
+        rate, iqr, dropped = _rate_stats(cross, paired, 1)
+        result["cluster_fanout_1k"] = {
+            "tasks_per_sec": rate, "tasks_per_sec_iqr": iqr,
+            "outlier_slopes_dropped": dropped,
+            "repeats": max(3, repeats - 2),
+            "task_latency_us": statistics.median(cross) * 1e6,
+        }
+    except Exception as e:  # noqa: BLE001 — cluster spin-up optional
+        result["cluster_fanout_1k"] = {"skipped": repr(e)}
+    result["timing"] = ("two-point marginal over fresh-process probes, "
+                        "paired-slope IQR")
+    return result
+
+
 def bench_rl_rollout(repeats=6):
     """Config #5: PPO rollout collection, CartPole, 64 vectorized envs.
     Marginal-timed via fresh-process probes (honest-timing note at
@@ -735,6 +782,93 @@ def _probe_main(args):
         final = float(np.asarray(ref.get()))
         wall = time.perf_counter() - t0
         assert abs(final - width) < 1.0, final
+    elif args.probe in ("cp_chain", "cp_fanout", "cp_latency"):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import ray_tpu
+
+        ray_tpu.init(num_tpus=0, worker_mode="thread")
+
+        @ray_tpu.remote
+        def noop(x):
+            return x
+
+        assert ray_tpu.get(noop.remote(41)) == 41  # warm the plane
+        if args.probe == "cp_latency":
+            times = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                assert ray_tpu.get(noop.remote(i)) == i
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            print(json.dumps({
+                "p50_s": times[len(times) // 2],
+                "p99_s": times[min(len(times) - 1,
+                                   int(len(times) * 0.99))],
+            }))
+            return
+        t0 = time.perf_counter()
+        if args.probe == "cp_chain":
+            ref = noop.remote(0)
+            for _ in range(n - 1):
+                ref = noop.remote(ref)
+            assert ray_tpu.get(ref, timeout=600) == 0
+        else:
+            refs = [noop.remote(i) for i in range(n)]
+            out = ray_tpu.get(refs, timeout=600)
+            assert out == list(range(n))  # byte-identical results
+        wall = time.perf_counter() - t0
+    elif args.probe == "cp_cluster":
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # The head/node subprocesses import ray_tpu by module path.
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = []
+        try:
+            head = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.head_service",
+                 "--port", "0"],
+                stdout=subprocess.PIPE, text=True, env=env)
+            procs.append(head)
+            line = head.stdout.readline()
+            assert "listening" in line, f"head failed to start: {line!r}"
+            address = line.strip().rsplit(" ", 1)[-1]
+            node = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.node_daemon",
+                 "--address", address, "--num-cpus", "2",
+                 "--worker-mode", "thread"],
+                stdout=subprocess.PIPE, text=True, env=env)
+            procs.append(node)
+            line = node.stdout.readline()
+            assert "joined" in line, f"node failed to join: {line!r}"
+            import ray_tpu
+
+            # Zero local CPUs: every task crosses the transport to the
+            # node daemon and its results pull back over the wire.
+            ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                         address=address)
+
+            @ray_tpu.remote
+            def noop(x):
+                return x
+
+            assert ray_tpu.get(noop.remote(41), timeout=60) == 41
+            t0 = time.perf_counter()
+            refs = [noop.remote(i) for i in range(n)]
+            out = ray_tpu.get(refs, timeout=600)
+            wall = time.perf_counter() - t0
+            assert out == list(range(n))
+        finally:
+            for p in reversed(procs):
+                p.kill()
+                p.wait(timeout=5)
     elif args.probe == "rl":
         from ray_tpu.rl.env import CartPole
         from ray_tpu.rl.env_runner import EnvRunner
@@ -771,7 +905,8 @@ def main():
     parser.add_argument("--all", action="store_true",
                         help="run every suite, print per-suite results")
     parser.add_argument("--suite", choices=[
-        "chain", "fanout", "actor", "data", "rl", "model", "sharded"],
+        "chain", "fanout", "actor", "data", "rl", "model", "sharded",
+        "control_plane"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -791,6 +926,7 @@ def main():
         "rl": bench_rl_rollout,
         "model": bench_model_train_step,
         "sharded": bench_sharded,
+        "control_plane": bench_control_plane,
     }
 
     if args.suite:

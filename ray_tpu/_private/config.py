@@ -152,6 +152,10 @@ _D("memory_monitor_threshold", float, 0.95,
 _D("spill_backlog_factor", float, 4.0,
    "Route tasks to remote node daemons when the local backlog exceeds "
    "factor times num_cpus and a feasible node is less loaded.")
+_D("external_pull_ttl_s", float, 600.0,
+   "Bound on post-completion pull retries for remote actor-task results "
+   "(mirrors the ActorHost result-pin TTL): past it the object is "
+   "declared lost instead of retrying forever.")
 _D("worker_channel_bytes", int, 1024 * 1024,
    "Request/reply channel buffer size per worker process (4 channels per "
    "worker are resident in the shm store; larger blobs are staged as "

@@ -8,6 +8,16 @@ through — relayed actor calls from other drivers, chunked object reads,
 task pushes (node role) and task completions (driver role) — served by a
 small thread pool against the local runtime.
 
+The request channel is **coalesced**: callers enqueue, and a single
+flusher thread ships everything that accumulated during the previous
+round trip as one ``("batch", msgs)`` frame (flush-on-idle, flush at
+256). The head answers ``("batchrep", replies)`` in request order and
+runs batch members concurrently, so N task pushes / task-done reports /
+object announces cost ~1 round trip, not N — while every caller still
+gets exactly its own reply (per-message semantics preserved). The
+heartbeat channel stays dedicated and unbatched: liveness must not
+queue behind bulk traffic.
+
 All three channels **reconnect-and-resume**: if the head restarts (it
 persists its directories — GCS FT), the heartbeat loop re-dials until the
 head answers, requests retry once over a fresh connection, and the event
@@ -33,6 +43,40 @@ from ray_tpu._private.transport import (
 )
 
 _PULL_CHUNK = 4 * 1024 * 1024  # object pulls ride 4 MiB frames
+_PULL_WINDOW = 16   # outstanding relayed chunk requests per pull
+_REQ_BATCH_MAX = 256  # request-coalescer flush-at-N bound
+# Reply-heavy requests (each answer can be MBs — chunk reads, whole-
+# object relays) are capped per batch so a batchrep frame stays far
+# below MAX_FRAME: 24 x 4 MiB chunks ≈ 96 MiB worst case.
+_REQ_BATCH_HEAVY_MAX = 24
+_HEAVY_KINDS = frozenset({"object_chunk", "object_pull"})
+# Aggregate request-byte budget per batch (estimated from top-level
+# bytes fields): big inlined payloads flush in small batches instead of
+# being packed into a near-cap frame only to be split and re-packed.
+_REQ_BATCH_BYTES = 64 << 20
+# Relays that execute remote side effects exactly once: NEVER blindly
+# resent after a post-write connection failure (the head may have
+# executed them before the reply was lost).
+_NON_IDEMPOTENT_KINDS = frozenset({"actor_call", "actor_push"})
+
+
+def _msg_bytes_estimate(msg: tuple) -> int:
+    """Cheap size estimate: top-level bytes-like fields carry virtually
+    all of a control message's weight (payloads, values, pickled args)."""
+    return 64 + sum(len(v) for v in msg
+                    if isinstance(v, (bytes, bytearray, memoryview)))
+
+
+class _ReqSlot:
+    """One in-flight coalesced request: the caller waits on ``event``;
+    the flusher fills ``reply`` (a raw wire reply) or ``exc``."""
+
+    __slots__ = ("event", "reply", "exc")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+        self.exc: Optional[BaseException] = None
 
 
 class Subscription:
@@ -86,7 +130,6 @@ class HeadClient:
         # driver's remote router consumes task completions.
         self.handlers: Dict[str, Callable[[tuple], Any]] = {}
         self.status_fn: Optional[Callable[[], dict]] = None
-        self._lock = threading.Lock()
         self._hb_lock = threading.Lock()
         self._subs_lock = threading.Lock()
         self._subs: Dict[str, list] = {}  # topic -> delivery callbacks
@@ -95,6 +138,22 @@ class HeadClient:
         self._req = self._dial("request")
         self._hb = self._dial("request")
         self._event = self._dial("event")
+        # Request coalescer: callers enqueue; a single flusher thread
+        # drains whatever accumulated while the previous round trip was
+        # in flight into ONE batch frame (flush-on-idle / flush-at-N),
+        # so a 10k fan-out of task pushes costs hundreds of round trips
+        # instead of tens of thousands. Per-message reply semantics are
+        # preserved: each caller waits on its own slot.
+        from collections import deque as _deque
+
+        self._req_queue: "_deque" = _deque()
+        self._req_cv = threading.Condition()
+        self.req_msgs_sent = 0
+        self.req_batches_sent = 0
+        self._flusher = threading.Thread(
+            target=self._request_flush_loop, daemon=True,
+            name="ray_tpu_head_reqflush")
+        self._flusher.start()
         self._pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu_head_event")
         # Chunked-read serialization cache: byte-capped LRU so one GB-
@@ -107,9 +166,13 @@ class HeadClient:
         self._serialized_cache_cap = 256 << 20
         self._serialized_cache_lock = threading.Lock()
         # Relayed-call results pinned until pulled (bounded FIFO).
+        # Guarded by its own lock: relayed actor_call events each run on
+        # a dedicated thread (plus the pool), and unlocked concurrent
+        # insert/popitem can corrupt the OrderedDict and drop pins.
         from collections import OrderedDict
 
         self._pinned_results: "OrderedDict[bytes, Any]" = OrderedDict()
+        self._pinned_results_lock = threading.Lock()
         # Direct data plane (ObjectManager role): serve local objects to
         # peers; pull remote objects peer-to-peer when the head knows the
         # owner's address, falling back to head-relayed chunks.
@@ -161,25 +224,157 @@ class HeadClient:
                 RuntimeError(str(value))
         return value
 
-    def _request(self, msg: tuple):
-        try:
-            with self._lock:
-                self._req.send(msg)
-                return self._check(self._req.recv())
-        except (EOFError, OSError, ConnectionError):
+    def _request_async(self, msg: tuple) -> _ReqSlot:
+        """Enqueue one RPC for the coalescer; returns the slot to redeem
+        with ``_request_result``. Lets callers keep many requests in
+        flight (windowed chunk pulls) — they ride shared batch frames."""
+        slot = _ReqSlot()
+        with self._req_cv:
             if self._stop.is_set():
-                raise
-            # One reconnect-and-retry: covers a restarted head (FT) and
-            # transient socket death. Non-idempotent ops here are put-style
-            # (last-write-wins) so the retry is safe.
-            with self._lock:
+                slot.exc = ConnectionError("head client is closed")
+                slot.event.set()
+                return slot
+            self._req_queue.append((msg, slot))
+            self._req_cv.notify()
+        return slot
+
+    def _request_result(self, slot: _ReqSlot):
+        slot.event.wait()
+        if slot.exc is not None:
+            raise slot.exc
+        return self._check(slot.reply)
+
+    def _request(self, msg: tuple):
+        return self._request_result(self._request_async(msg))
+
+    def _request_flush_loop(self):
+        while True:
+            with self._req_cv:
+                while not self._req_queue and not self._stop.is_set():
+                    self._req_cv.wait()
+                if not self._req_queue:
+                    return  # closed and drained
+                batch = []
+                heavy = 0
+                nbytes = 0
+                while self._req_queue and len(batch) < _REQ_BATCH_MAX:
+                    msg = self._req_queue[0][0]
+                    if msg and msg[0] in _HEAVY_KINDS:
+                        if heavy >= _REQ_BATCH_HEAVY_MAX:
+                            break  # next batch: bound the reply frame
+                        heavy += 1
+                    nbytes += _msg_bytes_estimate(msg)
+                    if batch and nbytes > _REQ_BATCH_BYTES:
+                        break  # next batch: bound the request frame
+                    batch.append(self._req_queue.popleft())
+            self._flush_batch(batch)
+
+    class _FrameTooLarge(Exception):
+        """Batch frame exceeds MAX_FRAME — raised BEFORE any write, so
+        splitting the batch and resending is safe."""
+
+    def _roundtrip_batch(self, payload: bytes, n_msgs: int) -> list:
+        """Wire phase only — ``payload`` is the pre-packed frame."""
+        from ray_tpu._private.transport import MAX_FRAME
+
+        if len(payload) > MAX_FRAME:
+            raise self._FrameTooLarge(len(payload))
+        self.req_msgs_sent += n_msgs
+        if n_msgs > 1:
+            self.req_batches_sent += 1
+        self._req._send_frame(payload)
+        rep = self._req.recv()
+        if n_msgs == 1:
+            return [rep]
+        if rep and rep[0] == "batchrep_split":
+            # Oversized reply set: the head ships one frame per reply
+            # so no single frame can breach MAX_FRAME.
+            if rep[1] != n_msgs:
+                raise ConnectionError("batch reply count mismatch")
+            return [self._req.recv() for _ in range(n_msgs)]
+        if not rep or rep[0] != "batchrep" or len(rep[1]) != n_msgs:
+            raise ConnectionError(
+                "head answered a batch frame with a non-batch reply")
+        return list(rep[1])
+
+    def _flush_batch(self, batch: list):
+        from ray_tpu._private.transport import pack
+
+        msgs = [m for m, _ in batch]
+        # Pack BEFORE touching the socket: an unencodable value must be
+        # isolated to its own caller without desyncing the reply stream
+        # (retrying one-by-one is only legal when nothing was written).
+        try:
+            if len(msgs) == 1:
+                payload = pack(msgs[0])
+            else:
+                payload = pack(("batch", tuple(msgs)))
+        except Exception as exc:  # noqa: BLE001 — unencodable value
+            if len(batch) > 1:
+                for item in batch:
+                    self._flush_batch([item])
+            else:
+                self._fail_batch(batch, exc)
+            return
+        try:
+            replies = self._roundtrip_batch(payload, len(msgs))
+        except self._FrameTooLarge as exc:
+            # Nothing was written: split and resend — messages that fit
+            # individually (each capped at MAX_FRAME pre-PR) still
+            # succeed; only a single over-cap message fails its caller.
+            if len(batch) > 1:
+                mid = len(batch) // 2
+                self._flush_batch(batch[:mid])
+                self._flush_batch(batch[mid:])
+            else:
+                self._fail_batch(batch, ValueError(
+                    f"request frame too large: {exc}"))
+            return
+        except Exception as exc:  # noqa: BLE001 — any post-write failure
+            # Bytes may be on the wire and the reply stream is suspect:
+            # the ONLY safe recovery is a fresh connection, and only for
+            # idempotent members. Retried ops are put-style (last-write-
+            # wins); actor_call/actor_push relays may have EXECUTED
+            # before the reply was lost, so resending would double a
+            # remote side effect — their callers get the error instead.
+            if self._stop.is_set():
+                self._fail_batch(batch, exc)
+                return
+            unsafe = [it for it in batch
+                      if it[0] and it[0][0] in _NON_IDEMPOTENT_KINDS]
+            if unsafe:
+                self._fail_batch(unsafe, ConnectionError(
+                    f"connection died mid-call; the relay may or may not "
+                    f"have executed ({exc})"))
+                batch = [it for it in batch
+                         if not (it[0] and it[0][0]
+                                 in _NON_IDEMPOTENT_KINDS)]
+                if not batch:
+                    return
+                msgs = [m for m, _ in batch]
+            try:
                 try:
                     self._req.close()
                 except Exception:  # noqa: BLE001
                     pass
                 self._req = self._dial("request")
-                self._req.send(msg)
-                return self._check(self._req.recv())
+                if len(msgs) == 1:
+                    payload = pack(msgs[0])
+                else:
+                    payload = pack(("batch", tuple(msgs)))
+                replies = self._roundtrip_batch(payload, len(msgs))
+            except Exception as exc2:  # noqa: BLE001 — still down
+                self._fail_batch(batch, exc2)
+                return
+        for (_, slot), rep in zip(batch, replies):
+            slot.reply = rep
+            slot.event.set()
+
+    @staticmethod
+    def _fail_batch(batch: list, exc: BaseException):
+        for _, slot in batch:
+            slot.exc = exc
+            slot.event.set()
 
     # ------------------------------------------------------------------ kv
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True):
@@ -255,23 +450,37 @@ class HeadClient:
         return self._object_pull_relayed(oid_bin)
 
     def _object_pull_relayed(self, oid_bin: bytes) -> Optional[bytes]:
+        """Head-relayed chunked pull with a request window: up to
+        _PULL_WINDOW chunk RPCs stay in flight (they coalesce into batch
+        frames and the head relays them concurrently), so transfer
+        overlaps round-trip latency instead of serializing behind it."""
         size = self._request(("object_meta", oid_bin))
         if size is None:
             return None
+        offsets = list(range(0, size, _PULL_CHUNK))
         parts = []
-        offset = 0
-        while offset < size:
-            length = min(_PULL_CHUNK, size - offset)
-            chunk = self._request(("object_chunk", oid_bin, offset, length))
+        slots: list = []
+        issued = 0
+        while len(parts) < len(offsets):
+            while issued < len(offsets) and issued - len(parts) < \
+                    _PULL_WINDOW:
+                offset = offsets[issued]
+                length = min(_PULL_CHUNK, size - offset)
+                slots.append(self._request_async(
+                    ("object_chunk", oid_bin, offset, length)))
+                issued += 1
+            chunk = self._request_result(slots[len(parts)])
             if not chunk:
                 # None: owner died mid-pull. b'': owner re-announced with
                 # shorter bytes than the cached meta — either way this
                 # pull is void; the caller re-resolves from scratch.
                 return None
             parts.append(chunk)
-            offset += len(chunk)
+        data = b"".join(parts)
+        if len(data) != size:
+            return None  # owner re-announced shorter bytes mid-pull
         self.relayed_pulls += 1
-        return b"".join(parts)
+        return data
 
     # --------------------------------------------------------------- nodes
     def node_register(self, node_id: str, resources: Dict[str, float]):
@@ -373,14 +582,18 @@ class HeadClient:
         a result a slow caller has not fetched yet."""
         import time as _time
 
+        from ray_tpu._private.config import GlobalConfig
+
+        ttl = GlobalConfig.external_pull_ttl_s  # keep pin life == retry bound
         now = _time.monotonic()
-        self._pinned_results[ref.object_id.binary()] = (ref, now)
-        while self._pinned_results:
-            _, (_, ts) = next(iter(self._pinned_results.items()))
-            if now - ts > 600.0 or len(self._pinned_results) > 4096:
-                self._pinned_results.popitem(last=False)
-            else:
-                break
+        with self._pinned_results_lock:
+            self._pinned_results[ref.object_id.binary()] = (ref, now)
+            while self._pinned_results:
+                _, (_, ts) = next(iter(self._pinned_results.items()))
+                if now - ts > ttl or len(self._pinned_results) > 4096:
+                    self._pinned_results.popitem(last=False)
+                else:
+                    break
 
     def _serialized_bytes(self, oid_bin: bytes) -> bytes:
         """Serialized form of a locally-owned object, cached briefly so a
@@ -448,7 +661,8 @@ class HeadClient:
             return len(self._serialized_bytes(event[1]))
         if kind == "object_chunk":
             _, oid_bin, offset, length = event
-            return self._serialized_bytes(oid_bin)[offset:offset + length]
+            raw = self._serialized_bytes(oid_bin)
+            return memoryview(raw)[offset:offset + length]
         raise ValueError(f"unknown event {kind!r}")
 
     # -------------------------------------------------------------- pubsub
@@ -528,6 +742,13 @@ class HeadClient:
 
     def close(self):
         self._stop.set()
+        # Wake the flusher and fail anything still queued — callers must
+        # not hang on slots nobody will ever serve.
+        with self._req_cv:
+            pending = list(self._req_queue)
+            self._req_queue.clear()
+            self._req_cv.notify_all()
+        self._fail_batch(pending, ConnectionError("head client is closed"))
         self._pool.shutdown(wait=False, cancel_futures=True)
         # The direct data plane must die with the client or its listener
         # port and peer sockets leak (one pair per init/shutdown cycle).

@@ -38,6 +38,7 @@ import os
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private.transport import (
@@ -54,6 +55,19 @@ from ray_tpu._private.transport import (
 DEFAULT_PORT = 6380
 
 _HEARTBEAT_PERIOD_S = 0.5
+
+# Estimated batchrep payloads above this ship as one small header frame
+# plus one frame PER REPLY, so a batch of large replies (multi-MiB
+# kv_get values etc.) can never assemble a single frame past MAX_FRAME.
+_BATCHREP_SPLIT_BYTES = 128 << 20
+
+
+def _reply_bytes_estimate(replies: list) -> int:
+    """Top-level bytes fields dominate reply weight (values, chunks)."""
+    return sum(
+        64 + (len(r[1]) if isinstance(r, tuple) and len(r) > 1
+              and isinstance(r[1], (bytes, bytearray, memoryview)) else 0)
+        for r in replies)
 
 
 def _client_timeout_s() -> float:
@@ -256,6 +270,13 @@ class HeadService:
         if state_path:
             self._restore(state_path)
             self._log = _StateLog(state_path)
+        # Batched control RPCs: a client's coalescer ships N requests in
+        # one frame; sub-requests dispatch CONCURRENTLY here so a batch
+        # of relays (task_push / task_done / chunk reads) overlaps their
+        # round trips instead of serializing them.
+        self._rpc_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="head-rpc")
+        self.batches_received = 0
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
@@ -385,6 +406,16 @@ class HeadService:
             conn.send(("ok", None))
             while not self._stop.is_set():
                 msg = conn.recv()
+                if msg and msg[0] == "batch":
+                    replies = self._dispatch_batch(client_id, msg[1])
+                    if _reply_bytes_estimate(replies) > \
+                            _BATCHREP_SPLIT_BYTES:
+                        conn.send(("batchrep_split", len(replies)))
+                        for r in replies:
+                            conn.send(r)
+                    else:
+                        conn.send(("batchrep", replies))
+                    continue
                 reply = self._dispatch(client_id, msg)
                 conn.send(reply)
         except (EOFError, OSError, ValueError):
@@ -393,6 +424,45 @@ class HeadService:
             pass
 
     # ------------------------------------------------------------ dispatch
+    def _dispatch_batch(self, client_id: str, msgs) -> list:
+        """One coalesced frame of N requests: replies come back in
+        request order, but sub-dispatch runs CONCURRENTLY (RPC pool /
+        dedicated threads), so requests inside a batch may EXECUTE in
+        any order. The invariant callers rely on: blocking `_request`
+        users have at most one request in flight, and `_request_async`
+        is reserved for order-independent requests (today: windowed
+        object_chunk reads). Do not route order-sensitive request pairs
+        through `_request_async`."""
+        self.batches_received += 1
+        msgs = list(msgs)
+        if len(msgs) <= 1:
+            return [self._dispatch(client_id, m) for m in msgs]
+
+        def _spawn_unbounded(m):
+            # actor_call relays wait for full method completion with NO
+            # timeout — on the shared pool a few slow methods would
+            # starve every client's bounded control traffic, so they
+            # get dedicated threads (mirroring the client event loop).
+            from concurrent.futures import Future
+
+            f: Future = Future()
+
+            def _run():
+                try:
+                    f.set_result(self._dispatch(client_id, m))
+                except BaseException as exc:  # noqa: BLE001
+                    f.set_exception(exc)
+
+            threading.Thread(target=_run, daemon=True,
+                             name="head-actor-relay").start()
+            return f
+
+        futures = [
+            _spawn_unbounded(m) if (m and m[0] == "actor_call")
+            else self._rpc_pool.submit(self._dispatch, client_id, m)
+            for m in msgs]
+        return [f.result() for f in futures]
+
     def _dispatch(self, client_id: str, msg: tuple):
         kind = msg[0]
         try:
@@ -718,6 +788,7 @@ class HeadService:
     def shutdown(self):
         self._stop.set()
         self._listener.close()
+        self._rpc_pool.shutdown(wait=False, cancel_futures=True)
         if self._log is not None:
             self._log.close()
 

@@ -31,10 +31,28 @@ import json
 import pickle
 import signal
 import threading
-from typing import Any, Dict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict
 
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.scheduler import TaskSpec
+
+
+def prefetch_serialized(pull_fn: Callable[[bytes], Any], oid_bins: list,
+                        pool: ThreadPoolExecutor) -> Dict[bytes, Any]:
+    """Pull many objects' serialized bytes CONCURRENTLY (pipelined
+    argument prefetch): every pull starts before any finishes, so a
+    task's dispatch overlaps its transfers instead of serializing
+    behind them. Returns {oid_bin: raw_or_None}; a pull that raised
+    maps to its exception (the caller decides per-arg)."""
+    futures = {ob: pool.submit(pull_fn, ob) for ob in dict.fromkeys(oid_bins)}
+    out: Dict[bytes, Any] = {}
+    for ob, fut in futures.items():
+        try:
+            out[ob] = fut.result()
+        except BaseException as exc:  # noqa: BLE001 — per-arg failure
+            out[ob] = exc
+    return out
 
 
 class NodeDaemon:
@@ -57,7 +75,49 @@ class NodeDaemon:
         self.actor_host = ActorHost(self.worker, self.head)
         self.head.node_register(
             self.worker.node_id.hex(), self.worker.resource_pool.total)
+        # Bounded pools replace the old thread-per-pushed-task model:
+        # _intake unpacks + prefetches args + submits; _pulls runs the
+        # concurrent argument pulls; _reporter ships task_done RPCs
+        # (which coalesce into batch frames at the head client).
+        self._intake = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="ray_tpu_node_intake")
+        self._pulls = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="ray_tpu_node_pull")
+        self._reporter = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="ray_tpu_node_done")
+        # Pushed-task function cache: a fan-out ships the SAME pickled
+        # function N times; deserialize it once per digest. Byte-capped
+        # LRU (pickle size as the weight proxy) so many distinct
+        # functions with fat closures can't pin unbounded memory.
+        from collections import OrderedDict
+
+        self._fn_cache: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._fn_cache_bytes = 0
+        self._fn_cache_cap = 64 << 20
+        self._fn_lock = threading.Lock()
         self._stop = threading.Event()
+
+    def _load_fn(self, fn_bytes: bytes):
+        import hashlib
+
+        import cloudpickle
+
+        key = hashlib.sha256(fn_bytes).digest()
+        with self._fn_lock:
+            hit = self._fn_cache.get(key)
+            if hit is not None:
+                self._fn_cache.move_to_end(key)
+                return hit[0]
+        fn = cloudpickle.loads(fn_bytes)
+        with self._fn_lock:
+            if key not in self._fn_cache:
+                self._fn_cache[key] = (fn, len(fn_bytes))
+                self._fn_cache_bytes += len(fn_bytes)
+            while self._fn_cache_bytes > self._fn_cache_cap \
+                    and len(self._fn_cache) > 1:
+                _, (_, nbytes) = self._fn_cache.popitem(last=False)
+                self._fn_cache_bytes -= nbytes
+        return fn
 
     def _status(self) -> dict:
         hosted = sum(1 for a in self.worker.actors.values()
@@ -73,10 +133,20 @@ class NodeDaemon:
     # ----------------------------------------------------------- task serve
     def _on_task_push(self, event: tuple):
         payload = pickle.loads(event[1])
-        threading.Thread(
-            target=self._run_task, args=(payload,), daemon=True,
-            name="ray_tpu_node_task").start()
+        self._intake.submit(self._start_task, payload)
         return "accepted"
+
+    def _ensure_object(self, oid_bin: bytes):
+        """Materialize one pull-ref's bytes into the local store."""
+        from ray_tpu._private.serialization import SerializedObject
+
+        oid = ObjectID(bytes(oid_bin))
+        if not self.worker.store.is_ready(oid):
+            raw = self.head.object_pull(oid.binary())
+            if raw is None:
+                raise ValueError(
+                    f"pull-ref {oid.hex()[:16]}… has no live owner")
+            self.worker.store.put(oid, SerializedObject.from_bytes(raw))
 
     def _unwire_arg(self, wired: tuple) -> Any:
         from ray_tpu._private.serialization import SerializedObject
@@ -85,24 +155,28 @@ class NodeDaemon:
         if kind == "v":
             return self.worker.serialization_context.deserialize(
                 SerializedObject.from_bytes(data))
-        # Pull-ref: the value lives on some node (possibly this one).
+        # Pull-ref: prefetched into the store by _start_task.
         oid = ObjectID(bytes(data))
-        if not self.worker.store.is_ready(oid):
-            raw = self.head.object_pull(oid.binary())
-            if raw is None:
-                raise ValueError(
-                    f"pull-ref {oid.hex()[:16]}… has no live owner")
-            self.worker.store.put(oid, SerializedObject.from_bytes(raw))
+        self._ensure_object(oid.binary())  # no-op when prefetch landed it
         serialized = self.worker.store.get(oid)
         return self.worker.serialization_context.deserialize(serialized)
 
-    def _run_task(self, payload: dict):
-        import cloudpickle
-
-        driver_id = payload["driver_id"]
+    def _start_task(self, payload: dict):
+        """Unpack a pushed task, prefetch its remote args in parallel,
+        submit to the local scheduler, and report completion from the
+        store's ready callbacks — no blocking wait, no per-task thread
+        (event-driven dispatch end to end)."""
         return_ids = [ObjectID(bytes(b)) for b in payload["return_ids"]]
         try:
-            fn = cloudpickle.loads(payload["fn"])
+            fn = self._load_fn(payload["fn"])
+            wired = list(payload["args"]) + list(payload["kwargs"].values())
+            pull_bins = [bytes(d) for k, d in wired if k == "r"]
+            if pull_bins:
+                prefetched = prefetch_serialized(
+                    self._ensure_object, pull_bins, self._pulls)
+                for exc in prefetched.values():
+                    if isinstance(exc, BaseException):
+                        raise exc
             args = tuple(self._unwire_arg(a) for a in payload["args"])
             kwargs = {k: self._unwire_arg(v)
                       for k, v in payload["kwargs"].items()}
@@ -117,8 +191,6 @@ class NodeDaemon:
                 retry_exceptions=payload["retry_exceptions"],
                 runtime_env=payload.get("runtime_env"))
             self.worker.scheduler.submit(spec)
-            # Wait for all outputs (errors also materialize as ready).
-            self.worker.store.wait(return_ids, len(return_ids), timeout=None)
         except BaseException as exc:  # noqa: BLE001 — report, don't die
             from ray_tpu.exceptions import RayTaskError
 
@@ -127,6 +199,24 @@ class NodeDaemon:
             for oid in return_ids:
                 if not self.worker.store.is_ready(oid):
                     self.worker.store.put_error(oid, err)
+        # Completion rides the store's ready callbacks (errors also
+        # materialize as ready): when the LAST output lands, report
+        # task_done from the reporter pool — the RPC itself coalesces
+        # into the head client's batch frames.
+        remaining = [len(return_ids)]
+        lock = threading.Lock()
+
+        def _one_ready():
+            with lock:
+                remaining[0] -= 1
+                if remaining[0] != 0:
+                    return
+            self._reporter.submit(self._report_done, payload, return_ids)
+
+        for oid in return_ids:
+            self.worker.store.on_ready(oid, _one_ready)
+
+    def _report_done(self, payload: dict, return_ids: list):
         done = pickle.dumps({
             "task_id": bytes(payload["task_id"]),
             "oid_bins": [o.binary() for o in return_ids],
@@ -134,7 +224,8 @@ class NodeDaemon:
         }, protocol=5)
         try:
             self.head.task_done(
-                driver_id, [o.binary() for o in return_ids], done)
+                payload["driver_id"], [o.binary() for o in return_ids],
+                done)
         except Exception:  # noqa: BLE001 — driver gone: results stay local
             pass
 
@@ -152,6 +243,8 @@ class NodeDaemon:
         import ray_tpu
 
         self._stop.set()
+        for pool in (self._intake, self._pulls, self._reporter):
+            pool.shutdown(wait=False, cancel_futures=True)
         self.actor_host.shutdown()
         ray_tpu.shutdown()
 

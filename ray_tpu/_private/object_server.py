@@ -27,6 +27,7 @@ from ray_tpu._private.transport import (
 )
 
 PULL_CHUNK = 4 << 20
+PULL_WINDOW = 8  # pipelined chunk requests in flight per direct pull
 
 
 class PeerUnreachableError(ConnectionError):
@@ -89,7 +90,10 @@ class ObjectServer:
                     _, oid, offset, length = msg
                     try:
                         raw = self._provider(bytes(oid))
-                        conn.send(("ok", raw[offset:offset + length]))
+                        # memoryview slice: the chunk reaches sendmsg
+                        # without an intermediate bytes copy.
+                        conn.send(("ok",
+                                   memoryview(raw)[offset:offset + length]))
                     except Exception:  # noqa: BLE001
                         conn.send(("ok", None))
                 elif kind in self.handlers:
@@ -110,71 +114,154 @@ class ObjectServer:
         self._listener.close()
 
 
+class _PeerLane:
+    """One socket to a peer. ``dead`` is set (while holding ``lock``)
+    by the user whose operation poisoned the protocol stream; later
+    acquirers check it before touching ``conn``, so a poisoned lane is
+    never reused and never closed under a concurrent user."""
+
+    __slots__ = ("conn", "lock", "dead")
+
+    def __init__(self, conn: FramedConnection):
+        self.conn = conn
+        self.lock = threading.Lock()
+        self.dead = False
+
+
 class PeerPool:
-    """Cached authenticated connections to peer object servers; one
-    in-flight request per peer (requests are serial per connection)."""
+    """Cached authenticated connections to peer object servers.
+    Requests are serial per CONNECTION, but each peer keeps a small
+    lane pool (up to _LANES sockets) so concurrent pulls from the
+    prefetch threads parallelize instead of convoying on one socket."""
+
+    _LANES = 3
 
     def __init__(self, token: str):
         self._token = token
-        self._conns: Dict[Tuple[str, int], FramedConnection] = {}
-        self._locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._lanes: Dict[Tuple[str, int], list] = {}  # addr -> [_PeerLane]
+        self._rr: Dict[Tuple[str, int], int] = {}  # busy-lane rotation
         self._lock = threading.Lock()
 
-    def _get(self, addr: Tuple[str, int]):
+    def _get(self, addr: Tuple[str, int]) -> _PeerLane:
+        """An idle lane when one exists; otherwise a fresh lane (up to
+        _LANES) or, at the cap, a round-robin pick over the busy lanes
+        so waiters spread instead of convoying on one socket."""
         with self._lock:
-            conn = self._conns.get(addr)
-            lock = self._locks.setdefault(addr, threading.Lock())
-        if conn is None:
-            conn = connect(addr[0], addr[1], self._token, timeout=5.0)
-            with self._lock:
-                self._conns[addr] = conn
-        return conn, lock
+            lanes = self._lanes.setdefault(addr, [])
+            for lane in lanes:
+                if not lane.lock.locked():
+                    return lane
+            if lanes and len(lanes) >= self._LANES:
+                self._rr[addr] = (self._rr.get(addr, 0) + 1) % len(lanes)
+                return lanes[self._rr[addr]]
+        lane = _PeerLane(connect(addr[0], addr[1], self._token,
+                                 timeout=5.0))
+        with self._lock:
+            lanes = self._lanes.setdefault(addr, [])
+            if len(lanes) < self._LANES:
+                lanes.append(lane)
+                return lane
+            # Raced past the cap while dialing: prefer a cached lane.
+            self._rr[addr] = (self._rr.get(addr, 0) + 1) % len(lanes)
+            picked = lanes[self._rr[addr]]
+        lane.conn.close()  # surplus socket, never cached
+        return picked
 
-    def _drop(self, addr: Tuple[str, int]):
+    def _drop(self, addr: Tuple[str, int], lane: Optional[_PeerLane]):
+        """Retire ONE dead lane. ``lane is None`` (the dial itself
+        failed — nothing was ever cached) is a no-op. Safe to close
+        without the lane lock: ``dead`` was set under the lock, and
+        every user checks it immediately after acquiring, so nobody can
+        be mid-operation on the socket."""
+        if lane is None:
+            return
         with self._lock:
-            conn = self._conns.pop(addr, None)
-        if conn is not None:
-            conn.close()
+            lanes = self._lanes.get(addr, [])
+            if lane in lanes:
+                lanes.remove(lane)
+        lane.conn.close()
 
     def pull(self, addr: Tuple[str, int],
              oid_bin: bytes) -> Optional[bytes]:
-        """Direct chunked pull; None on any failure (caller falls back to
-        the head-relayed path)."""
-        try:
-            conn, lock = self._get(addr)
-            with lock:
-                conn.send(("meta", oid_bin))
-                status, size = conn.recv()
-                if status != "ok" or size is None:
-                    return None
-                parts = []
-                offset = 0
-                while offset < size:
-                    length = min(PULL_CHUNK, size - offset)
-                    conn.send(("chunk", oid_bin, offset, length))
-                    status, chunk = conn.recv()
-                    if status != "ok" or not chunk:
-                        return None
-                    parts.append(chunk)
-                    offset += len(chunk)
-                return b"".join(parts)
-        except Exception:  # noqa: BLE001 — peer gone / handshake failed
-            self._drop(addr)
+        """Direct chunked pull with a pipelined request window: up to
+        PULL_WINDOW chunk requests ride ahead of the replies (issued via
+        one vectored ``send_many`` syscall per refill), so the transfer
+        overlaps request latency instead of paying a round trip per
+        chunk. None on any failure (caller falls back to the
+        head-relayed path); a failure mid-window poisons the connection
+        (unread replies), so it is dropped and redialed next use."""
+        for _ in range(2):  # one fresh-lane retry after a dead pick
+            lane = None
+            try:
+                lane = self._get(addr)
+                with lane.lock:
+                    if lane.dead:
+                        self._drop(addr, lane)
+                        continue  # its poisoner is retiring it
+                    try:
+                        return self._pull_on_lane(lane.conn, oid_bin)
+                    except Exception:
+                        lane.dead = True  # set UNDER the lock
+                        raise
+            except Exception:  # noqa: BLE001 — peer gone / poisoned lane
+                self._drop(addr, lane)
+                return None
+        return None
+
+    @staticmethod
+    def _pull_on_lane(conn: FramedConnection,
+                      oid_bin: bytes) -> Optional[bytes]:
+        """Windowed pull protocol on one locked lane. Raises on any
+        condition that leaves the reply stream unusable (unread
+        in-flight replies, short data) — the caller retires the lane."""
+        conn.send(("meta", oid_bin))
+        status, size = conn.recv()
+        if status != "ok" or size is None:
             return None
+        reqs = [("chunk", oid_bin, off, min(PULL_CHUNK, size - off))
+                for off in range(0, size, PULL_CHUNK)]
+        parts = []
+        issued = 0
+        while len(parts) < len(reqs):
+            upto = min(len(reqs), len(parts) + PULL_WINDOW)
+            if upto > issued:
+                conn.send_many(reqs[issued:upto])
+                issued = upto
+            status, chunk = conn.recv()
+            if status != "ok" or not chunk:
+                raise ConnectionError("chunk missing mid-window")
+            parts.append(chunk)
+        data = b"".join(parts)
+        if len(data) != size:
+            raise ConnectionError("object re-announced mid-pull")
+        return data
 
     def call(self, addr: Tuple[str, int], msg: tuple):
         """Direct request/response against a peer's registered handler.
         Raises on transport failure (caller falls back to the head relay)
         or re-raises the handler's wire error."""
-        try:
-            conn, lock = self._get(addr)
-            with lock:
-                conn.send(msg)
-                status, value = conn.recv()
-        except Exception as exc:
-            self._drop(addr)
-            raise PeerUnreachableError(
-                f"peer {addr[0]}:{addr[1]} unreachable: {exc}") from exc
+        status = value = None
+        for attempt in range(2):  # one fresh-lane retry after a dead pick
+            lane = None
+            try:
+                lane = self._get(addr)
+                with lane.lock:
+                    if lane.dead:
+                        self._drop(addr, lane)
+                        if attempt == 0:
+                            continue
+                        raise ConnectionError("peer lanes are poisoned")
+                    try:
+                        lane.conn.send(msg)
+                        status, value = lane.conn.recv()
+                        break
+                    except Exception:
+                        lane.dead = True  # set UNDER the lock
+                        raise
+            except Exception as exc:
+                self._drop(addr, lane)
+                raise PeerUnreachableError(
+                    f"peer {addr[0]}:{addr[1]} unreachable: {exc}") from exc
         if status == "err":
             raise wire_to_exc(value) if isinstance(value, dict) else \
                 RuntimeError(str(value))
@@ -182,9 +269,10 @@ class PeerPool:
 
     def close(self):
         with self._lock:
-            conns, self._conns = dict(self._conns), {}
-        for conn in conns.values():
-            conn.close()
+            lanes, self._lanes = dict(self._lanes), {}
+        for peer_lanes in lanes.values():
+            for lane in peer_lanes:
+                lane.conn.close()
 
 
 def local_ip_toward(sock: socket.socket) -> str:

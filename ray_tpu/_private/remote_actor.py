@@ -443,8 +443,12 @@ class ActorHost:
         # Lifecycle is time-based (callers pull promptly — ensure_local
         # fires on the task_done event), with a count cap as the memory
         # backstop; a FIFO-only cap could evict a not-yet-pulled result.
+        from ray_tpu._private.config import GlobalConfig
+
         self._pinned: "OrderedDict[bytes, tuple]" = OrderedDict()
-        self._pin_ttl_s = 600.0
+        # Coupled to the router's bounded pull-retry window: pins must
+        # outlive the retries or gets fail before the bytes expire.
+        self._pin_ttl_s = GlobalConfig.external_pull_ttl_s
         self._pin_cap = 16384
         head._object_server.handlers["actor_op"] = self._on_direct
         head.handlers["actor_push"] = self._on_push

@@ -77,7 +77,12 @@ class RemoteRouter:
         self._lock = threading.Lock()
         self._nodes_cache: tuple = (0.0, [])
         self._pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="ray_tpu_router")
+            max_workers=8, thread_name_prefix="ray_tpu_router")
+        # Prefetches block inside ensure_local (up to their timeout) —
+        # they get their OWN pool so queued task pushes and lineage
+        # re-execution on self._pool never starve behind them.
+        self._prefetch_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="ray_tpu_router_prefetch")
         self._stop = threading.Event()
         self._watcher = threading.Thread(
             target=self._watch_loop, daemon=True, name="ray_tpu_router_watch")
@@ -478,25 +483,34 @@ class RemoteRouter:
 
         def _run():
             try:
-                self.ensure_local(object_id, timeout=timeout)
+                self.ensure_local(object_id, timeout=timeout,
+                                  _from_prefetch=True)
             except Exception:  # noqa: BLE001 — best-effort prefetch
                 pass
             finally:
                 with self._lock:
                     self._prefetching.discard(object_id)
 
-        self._pool.submit(_run)
+        self._prefetch_pool.submit(_run)
 
     def ensure_local(self, object_id: ObjectID,
-                     timeout: Optional[float] = None) -> None:
+                     timeout: Optional[float] = None,
+                     _from_prefetch: bool = False) -> None:
         """Block until a router-owned object's bytes are in the local
-        store: wait for completion (with pull-polling so a missed
-        task_done event cannot hang us), chunk-pull from the owning node,
-        and re-execute from lineage if the owner died first."""
+        store: wait on the completion event (with pull-polling so a
+        missed task_done event cannot hang us), chunk-pull from the
+        owning node, and re-execute from lineage if the owner died
+        first. External (actor-task) results are never re-executed;
+        their post-completion pull retries are BOUNDED by the owner's
+        pin TTL — past it an ObjectLostError materializes into the
+        store instead of ray_tpu.get hanging forever on evicted bytes."""
         from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu.exceptions import ObjectLostError
 
         deadline = None if timeout is None else time.monotonic() + timeout
         tid = object_id.task_id()
+        external_deadline = None
+        backoff = 0.05
         while not self.worker.store.is_ready(object_id):
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
@@ -507,7 +521,19 @@ class RemoteRouter:
                 exc = self._failed.get(tid)
             if exc is not None:
                 return  # error already materialized into the store
+            if not _from_prefetch:
+                # A background prefetch is already transferring this
+                # object: wait for it instead of starting a duplicate
+                # full-byte pull (get() kicks off prefetches for the
+                # whole ref list right before its foreground loop).
+                with self._lock:
+                    prefetching = object_id in self._prefetching
+                if prefetching:
+                    self.worker.store.wait([object_id], 1, timeout=0.25)
+                    continue
             if ev is not None:
+                # Event-driven completion wakeup; the bounded wait only
+                # covers the missed-task_done case (head restart).
                 ev.wait(timeout=0.5)
             # Pull unconditionally each round: the head's object directory
             # knows completed results even if this driver missed the
@@ -526,10 +552,29 @@ class RemoteRouter:
                     external = tid in self.external
                 if external:
                     # Actor-task result: never re-executed. The hosting
-                    # node may still be serializing — retry; if the node
-                    # died, the RemoteActorRuntime watcher materializes
-                    # an ActorDiedError into the store, ending this loop.
-                    time.sleep(0.05)
+                    # node may still be serializing — retry with backoff;
+                    # if the node died, the RemoteActorRuntime watcher
+                    # materializes an ActorDiedError. If the node is
+                    # alive but its pin TTL/cap evicted the bytes, every
+                    # pull returns None forever — bound the retries and
+                    # declare the object lost.
+                    if external_deadline is None:
+                        external_deadline = (
+                            time.monotonic()
+                            + GlobalConfig.external_pull_ttl_s)
+                    elif time.monotonic() > external_deadline:
+                        self.worker.store.put_error(
+                            object_id, ObjectLostError(
+                                f"remote actor-task result "
+                                f"{object_id.hex()[:16]}… completed but "
+                                f"its bytes are no longer served by the "
+                                f"hosting node (result pin expired or "
+                                f"evicted); actor tasks are not "
+                                f"re-executed from lineage"))
+                        return
+                    if self._stop.wait(backoff):
+                        return  # router shutting down
+                    backoff = min(backoff * 2, 1.0)
                     continue
                 # Task finished but its owner cannot serve the bytes:
                 # the node died holding them. Re-execute from lineage.
@@ -619,3 +664,4 @@ class RemoteRouter:
     def shutdown(self):
         self._stop.set()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._prefetch_pool.shutdown(wait=False, cancel_futures=True)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -55,6 +56,15 @@ class ResourcePool:
         self._total = dict(total)
         self._available = dict(total)
         self._cv = threading.Condition()
+        self._release_listeners: List[Callable[[], None]] = []
+
+    def add_release_listener(self, cb: Callable[[], None]):
+        """Event-driven wakeup hook: ``cb`` fires after every release,
+        OUTSIDE the pool lock (listeners may take their own locks that
+        also nest around try_acquire — calling under the pool lock
+        would close an ABBA cycle)."""
+        with self._cv:
+            self._release_listeners.append(cb)
 
     @property
     def total(self) -> Dict[str, float]:
@@ -81,6 +91,9 @@ class ResourcePool:
             for k, v in demand.items():
                 self._available[k] = self._available.get(k, 0.0) + v
             self._cv.notify_all()
+            listeners = list(self._release_listeners)
+        for cb in listeners:
+            cb()
 
     def wait_for_change(self, timeout: float = 0.5):
         with self._cv:
@@ -112,7 +125,14 @@ class LocalScheduler:
         self._events = task_events
         self._lineage = lineage if lineage is not None else {}
         self._lock = threading.Lock()
-        self._runnable: List[TaskSpec] = []  # deps resolved, waiting on CPU
+        # Runnable tasks bucketed by resource shape: dispatch picks the
+        # lowest-sequence head whose shape fits *now*, trying each
+        # distinct shape at most once per drain — O(#shapes) per
+        # dispatched task instead of the old O(len(runnable)) FIFO scan
+        # that re-tried every queued task's acquire on every wakeup.
+        self._runnable: Dict[tuple, Any] = {}  # shape -> deque[(seq, spec)]
+        self._runnable_count = 0
+        self._runnable_seq = 0
         self._pending_deps: Dict[TaskID, int] = {}
         self._cancelled: set = set()
         self._running: Dict[TaskID, threading.Event] = {}
@@ -137,6 +157,8 @@ class LocalScheduler:
         self._shm_key_pins: Dict[int, int] = {}  # key -> in-flight count
         self._deferred_deletes: set = set()  # pinned keys awaiting delete
         self._pin_lock = threading.Lock()  # leaf lock: nothing nests in it
+        # Unpin events wake _clear_ret_keys waiters (no sleep-poll).
+        self._pin_cv = threading.Condition(self._pin_lock)
         # Tasks whose workers the memory monitor killed: their crash is
         # reported as OutOfMemoryError, not a generic worker crash.
         self._oom_killed: set = set()
@@ -162,6 +184,9 @@ class LocalScheduler:
                 name="ray_tpu_dq_pump",
             )
             self._dq_pump.start()
+        # Event-driven dispatch: resource release signals the dispatch
+        # condition instead of the loop polling wait_for_change(0.05).
+        resource_pool.add_release_listener(self._on_resources_released)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="ray_tpu_dispatcher",
@@ -176,6 +201,14 @@ class LocalScheduler:
                                 name=spec.name)
         self._lineage[spec.return_ids[0].task_id()] = spec
         dep_refs = _collect_refs(spec.args, spec.kwargs)
+        if not dep_refs:
+            # Born-ready fast path: queue for dispatch directly. Routing
+            # through the native ring (alloc + commit + a pump-thread
+            # hop) buys nothing for a task with no pending producers.
+            with self._lock:
+                self._backlog += 1
+                self._make_runnable_locked(spec)
+            return
         if self._dq is not None:
             try:
                 return self._submit_native(spec, dep_refs)
@@ -183,9 +216,6 @@ class LocalScheduler:
                 pass  # queue full: fall through to the python path
         with self._lock:
             self._backlog += 1
-            if not dep_refs:
-                self._make_runnable_locked(spec)
-                return
             self._pending_deps[spec.task_id] = len(dep_refs)
 
         def _on_dep_ready():
@@ -289,33 +319,100 @@ class LocalScheduler:
                 pass
 
     def _make_runnable_locked(self, spec: TaskSpec):
-        self._runnable.append(spec)
+        self._runnable_seq += 1
+        dq = self._runnable.get(_shape_key(spec.resources))
+        if dq is None:
+            dq = self._runnable[_shape_key(spec.resources)] = deque()
+        dq.append((self._runnable_seq, spec))
+        self._runnable_count += 1
         if self._events:
             self._events.record(spec.task_id, "PENDING_NODE_ASSIGNMENT",
                                 name=spec.name)
         self._dispatch_cv.notify_all()
 
+    def queued_specs(self) -> List[TaskSpec]:
+        """Snapshot of runnable-but-undispatched tasks in FIFO order."""
+        with self._lock:
+            items = [item for dq in self._runnable.values() for item in dq]
+        items.sort(key=lambda it: it[0])
+        return [spec for _, spec in items]
+
     # -------------------------------------------------------------- dispatch
+    def _drain_dispatchable_locked(self, limit: int = 0) -> List[TaskSpec]:
+        """Pop every runnable task whose resources fit right now (up to
+        ``limit`` when nonzero), FIFO across shape buckets. A shape that
+        fails try_acquire is skipped for the rest of the drain — its
+        whole bucket cannot fit until something releases."""
+        batch: List[TaskSpec] = []
+        blocked: Optional[set] = None
+        while self._runnable_count:
+            best_key = None
+            best_seq = 0
+            for key, dq in self._runnable.items():
+                if blocked is not None and key in blocked:
+                    continue
+                seq = dq[0][0]
+                if best_key is None or seq < best_seq:
+                    best_key, best_seq = key, seq
+            if best_key is None:
+                break
+            dq = self._runnable[best_key]
+            spec = dq[0][1]
+            if self._resources.try_acquire(spec.resources):
+                dq.popleft()
+                if not dq:
+                    del self._runnable[best_key]
+                self._runnable_count -= 1
+                batch.append(spec)
+                if limit and len(batch) >= limit:
+                    break
+            else:
+                if blocked is None:
+                    blocked = set()
+                blocked.add(best_key)
+        return batch
+
+    def _on_resources_released(self):
+        """ResourcePool release listener (called outside the pool lock):
+        wake dispatch if anything is waiting on capacity."""
+        with self._lock:
+            if self._runnable_count and not self._shutdown:
+                self._dispatch_cv.notify_all()
+
     def _dispatch_loop(self):
         while True:
             with self._lock:
-                while not self._runnable and not self._shutdown:
-                    self._dispatch_cv.wait(0.2)
-                if self._shutdown:
-                    return
-                # FIFO scan for the first task whose resources fit now.
-                picked = None
-                for i, spec in enumerate(self._runnable):
-                    if self._resources.try_acquire(spec.resources):
-                        picked = self._runnable.pop(i)
+                while True:
+                    if self._shutdown:
+                        return
+                    batch = self._drain_dispatchable_locked()
+                    if batch:
                         break
-            if picked is None:
-                self._resources.wait_for_change(0.05)
-                continue
-            self._pool.submit(self._execute, picked)
+                    # Event-driven: woken by _make_runnable_locked, the
+                    # resource-release listener, or shutdown. No timed
+                    # poll remains on this edge.
+                    self._dispatch_cv.wait()
+            for spec in batch:
+                self._pool.submit(self._execute, spec)
 
     # ------------------------------------------------------------- execution
+    def _pick_next_inline(self) -> Optional[TaskSpec]:
+        """Work-continuation: the worker thread that just finished a task
+        pulls the next fitting one itself, skipping the release→notify→
+        dispatch→pool round trip (two context switches per task on the
+        hot path)."""
+        with self._lock:
+            if self._shutdown:
+                return None
+            batch = self._drain_dispatchable_locked(limit=1)
+        return batch[0] if batch else None
+
     def _execute(self, spec: TaskSpec):
+        nxt: Optional[TaskSpec] = spec
+        while nxt is not None:
+            nxt = self._execute_one(nxt)
+
+    def _execute_one(self, spec: TaskSpec) -> Optional[TaskSpec]:
         from ray_tpu._private import worker as worker_mod
 
         cancelled_event = threading.Event()
@@ -329,7 +426,7 @@ class LocalScheduler:
             # otherwise — the teardown hang when cancel races dispatch).
             self._resources.release(spec.resources)
             self._finish_cancelled(spec)
-            return
+            return self._pick_next_inline()
 
         if self._events:
             self._events.record(spec.task_id, "RUNNING", name=spec.name)
@@ -392,6 +489,7 @@ class LocalScheduler:
                 with self._lock:
                     self._backlog += 1
                     self._make_runnable_locked(retry_spec)
+        return self._pick_next_inline()
 
     def _resolve_args_proc(self, args, kwargs, pinned: list):
         """Arg resolution for the process plane: a ref whose value is
@@ -431,6 +529,7 @@ class LocalScheduler:
 
     def _unpin_shm_keys(self, pinned: list):
         with self._pin_lock:
+            self._pin_cv.notify_all()
             for key in pinned:
                 n = self._shm_key_pins.get(key, 0) - 1
                 if n <= 0:
@@ -479,9 +578,15 @@ class LocalScheduler:
                     except Exception:  # noqa: BLE001 — not present
                         pass
             remaining = still
-            if not remaining or time.monotonic() >= deadline:
+            if not remaining:
                 return
-            time.sleep(0.01)
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            # Event-driven: an unpin notifies; the timeout only bounds a
+            # reader that never unpins within the wait budget.
+            with self._pin_cv:
+                self._pin_cv.wait(min(left, 0.1))
 
     @staticmethod
     def _ret_key(oid, attempt: int) -> int:
@@ -701,14 +806,26 @@ class LocalScheduler:
         """
         with self._lock:
             self._cancelled.add(task_id)
-            for i, spec in enumerate(self._runnable):
-                if spec.task_id == task_id:
-                    self._runnable.pop(i)
-                    threading.Thread(
-                        target=self._finish_cancelled, args=(spec,),
-                        daemon=True,
-                    ).start()
-                    return True
+            found = None
+            for key, dq in self._runnable.items():
+                for i, (_, spec) in enumerate(dq):
+                    if spec.task_id == task_id:
+                        found = (key, i, spec)
+                        break
+                if found:
+                    break
+            if found:
+                key, i, spec = found
+                dq = self._runnable[key]
+                del dq[i]
+                if not dq:
+                    del self._runnable[key]
+                self._runnable_count -= 1
+                threading.Thread(
+                    target=self._finish_cancelled, args=(spec,),
+                    daemon=True,
+                ).start()
+                return True
             ev = self._running.get(task_id)
             proc = self._proc_running.get(task_id)
             if ev is not None:
@@ -753,6 +870,11 @@ class LocalScheduler:
             self._dq.wake()
             self._dq_pump.join(timeout=2)
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _shape_key(resources: Dict[str, float]) -> tuple:
+    """Hashable resource-demand shape (dispatch bucket key)."""
+    return tuple(sorted(resources.items()))
 
 
 def _collect_refs(args, kwargs) -> list:

@@ -17,6 +17,14 @@ and the GCS [unverified]). Design goals, per the tpu-first rewrite:
   payloads either. This is what makes a non-loopback bind legal.
 - **Length-prefixed frames** (u32 BE) with a hard size cap; large objects
   move as explicit chunked pulls above this layer, not giant frames.
+- **Zero-copy vectored IO.** ``_send_frame`` never concatenates header
+  and payload: both go out in one ``socket.sendmsg`` scatter-gather
+  call, and payloads may be any buffer (``bytes``/``bytearray``/
+  ``memoryview``), so serialized numpy blocks and object chunks reach
+  the NIC without an intermediate copy. ``send_many`` writes N frames
+  in one syscall (the batch coalescer and windowed chunk pulls ride
+  it). The read side fills a reused buffer via ``recv_into`` — one
+  allocation per *growth*, not per frame.
 
 Errors cross the wire as ``{"type", "module", "message"}`` maps and are
 reconstructed from a module whitelist — never unpickled.
@@ -38,6 +46,13 @@ import msgpack
 
 MAX_FRAME = 1 << 30  # 1 GiB: chunked pulls should keep frames far below this
 _LEN = struct.Struct(">I")
+
+# Scatter-gather writes are chunked to stay under the kernel's iovec
+# limit (UIO_MAXIOV is 1024 on Linux; each frame is 2 buffers).
+_IOV_FRAMES = 256
+# The reused receive buffer grows to the largest frame seen but is
+# re-shrunk past this bound so one giant pull doesn't pin memory.
+_RBUF_KEEP = 8 << 20
 
 
 # ------------------------------------------------------------------- token --
@@ -131,37 +146,97 @@ class FramedConnection:
         self._sendlock = threading.Lock()
         self._recvlock = threading.Lock()
         self._closed = False
+        self._hdr = bytearray(4)  # reused header recv buffer
+        self._rbuf = bytearray(64 * 1024)  # reused payload recv buffer
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     # raw framing -----------------------------------------------------------
-    def _send_frame(self, payload: bytes):
-        if len(payload) > MAX_FRAME:
-            raise ValueError(f"frame too large: {len(payload)}")
-        with self._sendlock:
-            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+    def _send_buffers_locked(self, buffers: list):
+        """Vectored write of every buffer, handling partial sendmsg."""
+        total = sum(len(b) for b in buffers)
+        sent = self._sock.sendmsg(buffers)
+        if sent == total:
+            return
+        # Partial write (signal, huge iovec): finish with sendall.
+        for b in buffers:
+            blen = len(b)
+            if sent >= blen:
+                sent -= blen
+                continue
+            self._sock.sendall(memoryview(b)[sent:])
+            sent = 0
 
-    def _recv_exact(self, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
+    def _send_frame(self, payload):
+        """One frame; ``payload`` is any bytes-like (memoryviews pass
+        through to the socket uncopied — header and payload go out in a
+        single scatter-gather syscall)."""
+        n = len(payload)
+        if n > MAX_FRAME:
+            raise ValueError(f"frame too large: {n}")
+        with self._sendlock:
+            self._send_buffers_locked([_LEN.pack(n), payload])
+
+    def _send_frames(self, payloads: list):
+        """N frames under one lock hold, ≤ _IOV_FRAMES frames per
+        syscall — the wire bytes are identical to N _send_frame calls."""
+        for p in payloads:
+            if len(p) > MAX_FRAME:
+                raise ValueError(f"frame too large: {len(p)}")
+        with self._sendlock:
+            for i in range(0, len(payloads), _IOV_FRAMES):
+                bufs = []
+                for p in payloads[i:i + _IOV_FRAMES]:
+                    bufs.append(_LEN.pack(len(p)))
+                    bufs.append(p)
+                self._send_buffers_locked(bufs)
+
+    def _recv_exact_into(self, view: memoryview):
+        got = 0
+        n = len(view)
+        while got < n:
+            r = self._sock.recv_into(view[got:])
+            if r == 0:
                 raise EOFError("connection closed")
-            buf += chunk
-        return bytes(buf)
+            got += r
+
+    def _recv_frame_locked_view(self) -> memoryview:
+        """Read one frame into the reused buffer; the returned view is
+        valid only until the next recv — callers either unpack
+        immediately or copy."""
+        self._recv_exact_into(memoryview(self._hdr))
+        (length,) = _LEN.unpack(self._hdr)
+        if length > MAX_FRAME:
+            raise ValueError(f"frame too large: {length}")
+        if length > len(self._rbuf):
+            self._rbuf = bytearray(length)
+        view = memoryview(self._rbuf)[:length]
+        self._recv_exact_into(view)
+        if len(self._rbuf) > _RBUF_KEEP and length <= _RBUF_KEEP:
+            # Copy out before shrinking the backing store.
+            data = bytearray(view)
+            self._rbuf = bytearray(64 * 1024)
+            return memoryview(data)
+        return view
 
     def _recv_frame(self) -> bytes:
         with self._recvlock:
-            (length,) = _LEN.unpack(self._recv_exact(4))
-            if length > MAX_FRAME:
-                raise ValueError(f"frame too large: {length}")
-            return self._recv_exact(length)
+            return bytes(self._recv_frame_locked_view())
 
     # typed API -------------------------------------------------------------
     def send(self, obj: Any):
         self._send_frame(pack(obj))
 
+    def send_many(self, objs: list):
+        """Write one frame per object in a single vectored syscall (per
+        _IOV_FRAMES group). Receivers see ordinary back-to-back frames."""
+        self._send_frames([pack(o) for o in objs])
+
     def recv(self) -> Any:
-        return unpack(self._recv_frame())
+        with self._recvlock:
+            # Unpacked in place from the reused buffer: msgpack copies
+            # bin fields into fresh bytes during decode, so the view's
+            # reuse on the next recv is safe.
+            return unpack(self._recv_frame_locked_view())
 
     def close(self):
         if not self._closed:
@@ -246,10 +321,31 @@ class TokenListener:
                 conn.close()
 
     def close(self):
+        host = port = None
+        try:
+            host, port = self._sock.getsockname()[:2]
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        # A thread blocked in accept() pins the listening socket open
+        # past the fd close (the in-flight syscall holds the file
+        # description), leaving the port accepting until the NEXT
+        # connection arrives. Deliver that connection ourselves so the
+        # accept returns now, its loop observes shutdown, and the port
+        # actually frees — deterministic teardown instead of a lingering
+        # zombie listener. Poke the BOUND address (loopback only for
+        # wildcard binds).
+        if port:
+            if not host or host == "0.0.0.0":
+                host = "127.0.0.1"
+            try:
+                socket.create_connection((host, port),
+                                         timeout=0.2).close()
+            except OSError:
+                pass
 
 
 def connect(host: str, port: int, token: str,

@@ -605,6 +605,15 @@ def get(refs: Union[ObjectRef, List[ObjectRef]],
         raise TypeError(
             f"get() expects an ObjectRef or list of ObjectRefs, got "
             f"{type(refs)}")
+    # Pipelined result prefetch: kick off background pulls for every
+    # remote-routed ref up front so the sequential get loop below finds
+    # most bytes already local instead of paying one pull RTT per ref.
+    router = worker.remote_router
+    if router is not None:
+        for r in refs:
+            if not worker.store.is_ready(r.object_id) \
+                    and router.handles(r.object_id):
+                router.prefetch(r.object_id)
     # One overall deadline across the whole list, not per ref.
     import time as _time
 

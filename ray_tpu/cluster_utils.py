@@ -90,7 +90,7 @@ class Cluster:
                 self.nodes.remove(node)
         # Fail/retry tasks currently on that node.
         running = list(node.scheduler._running.keys())
-        queued = list(node.scheduler._runnable)
+        queued = node.scheduler.queued_specs()
         node.scheduler.shutdown()
         for spec in queued:
             self._resubmit_or_fail(spec)

@@ -283,11 +283,13 @@ def read_binary_files(paths, **_opts) -> Dataset:
     return _from_read_tasks("ReadBinary", [make_task(f) for f in files])
 
 
-def read_tfrecords(paths, **_opts) -> Dataset:
+def read_tfrecords(paths, *, check_integrity: bool = True,
+                   **_opts) -> Dataset:
     """Read TFRecord files of tf.train.Example protos (no tensorflow
     dependency — see ray_tpu/data/tfrecords.py for the record framing +
     protobuf codec). Each feature key becomes a column; single-element
-    features scalarize."""
+    features scalarize. ``check_integrity`` (default on) additionally
+    validates each record's data CRC, not just the length CRC."""
     files = _expand_paths(paths)
 
     def make_task(f):
@@ -299,7 +301,9 @@ def read_tfrecords(paths, **_opts) -> Dataset:
             )
 
             with _open_path(f) as fh:
-                rows = [decode_example(r) for r in read_records(fh)]
+                rows = [decode_example(r)
+                        for r in read_records(
+                            fh, check_integrity=check_integrity)]
             return [examples_to_block(rows)]
 
         return task

@@ -194,7 +194,13 @@ def write_record(fh, data: bytes) -> None:
     fh.write(_CRC.pack(_masked_crc(data)))
 
 
-def read_records(fh) -> Iterator[bytes]:
+def read_records(fh, check_integrity: bool = True) -> Iterator[bytes]:
+    """Iterate raw record payloads. The length CRC is always checked
+    (framing integrity); with ``check_integrity=True`` (the default,
+    matching the reference reader) the per-record DATA CRC is verified
+    too, so payload corruption that leaves the length field intact
+    cannot pass silently into training data. Pass
+    ``check_integrity=False`` to trade that check for read speed."""
     while True:
         header = fh.read(8)
         if len(header) < 8:
@@ -206,7 +212,13 @@ def read_records(fh) -> Iterator[bytes]:
         data = fh.read(length)
         if len(data) < length:
             raise ValueError("TFRecord truncated mid-record")
-        fh.read(4)  # data CRC — validated on demand, skipped for speed
+        data_crc = fh.read(4)
+        if check_integrity:
+            if len(data_crc) < 4:
+                raise ValueError("TFRecord truncated mid-record")
+            if _CRC.unpack(data_crc)[0] != _masked_crc(data):
+                raise ValueError(
+                    "TFRecord data CRC mismatch (corrupt record)")
         yield data
 
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare the newest committed BENCH_pr*.json
+record against the previous one and fail when any shared throughput
+metric regressed more than the threshold (default 20 %).
+
+Usage:
+    python scripts/check_bench.py [--threshold 0.2] [--dir .]
+
+Record format (written by PR benches): a JSON object whose "after"
+section holds the measurement for the PR's final state. Throughput
+metrics are any numeric leaf whose key ends in "_per_sec" or equals
+"tasks_per_sec"; latency leaves (ending "_us"/"_s") gate in the other
+direction (higher is worse). With fewer than two records the gate
+passes trivially (nothing to regress against).
+
+Wired as ``make bench-gate``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _flatten(prefix: str, node, out: dict):
+    if isinstance(node, dict):
+        for k, v in node.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(node, (int, float)) and not isinstance(node, bool):
+        out[prefix] = float(node)
+
+
+def _metrics(record: dict) -> dict:
+    """Numeric leaves of the record's `after` section (fall back to the
+    whole record for externally-produced files)."""
+    flat: dict = {}
+    _flatten("", record.get("after", record), flat)
+    return flat
+
+
+def _is_throughput(key: str) -> bool:
+    leaf = key.rsplit(".", 1)[-1]
+    return leaf.endswith("_per_sec") or leaf == "tasks_per_sec"
+
+
+def _is_latency(key: str) -> bool:
+    leaf = key.rsplit(".", 1)[-1]
+    return (leaf.endswith("_us") or leaf.endswith("_latency_s")) and \
+        "iqr" not in leaf
+
+
+def compare(prev: dict, curr: dict, threshold: float) -> list:
+    """Return a list of human-readable regression strings."""
+    pm, cm = _metrics(prev), _metrics(curr)
+    regressions = []
+    for key in sorted(set(pm) & set(cm)):
+        old, new = pm[key], cm[key]
+        if old <= 0:
+            continue
+        if _is_throughput(key) and new < old * (1.0 - threshold):
+            regressions.append(
+                f"{key}: {new:.1f} < {old:.1f} "
+                f"(-{(1 - new / old) * 100:.0f}%)")
+        elif _is_latency(key) and new > old * (1.0 + threshold):
+            regressions.append(
+                f"{key}: {new:.1f} > {old:.1f} "
+                f"(+{(new / old - 1) * 100:.0f}%)")
+    return regressions
+
+
+def _record_order(path: str) -> tuple:
+    m = re.search(r"BENCH_pr(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="max allowed fractional regression (default 0.2)")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_pr*.json records")
+    args = ap.parse_args(argv)
+
+    records = sorted(glob.glob(os.path.join(args.dir, "BENCH_pr*.json")),
+                     key=_record_order)
+    if len(records) < 2:
+        print(f"bench-gate: {len(records)} record(s) found — "
+              f"nothing to compare, pass")
+        return 0
+    prev_path, curr_path = records[-2], records[-1]
+    with open(prev_path) as f:
+        prev = json.load(f)
+    with open(curr_path) as f:
+        curr = json.load(f)
+    regressions = compare(prev, curr, args.threshold)
+    base = (os.path.basename(prev_path), os.path.basename(curr_path))
+    if regressions:
+        print(f"bench-gate FAIL ({base[1]} vs {base[0]}, "
+              f"threshold {args.threshold:.0%}):")
+        for r in regressions:
+            print(f"  {r}")
+        return 1
+    print(f"bench-gate OK: {base[1]} holds within "
+          f"{args.threshold:.0%} of {base[0]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,6 +134,27 @@ def test_tfrecords_crc_detects_corruption(tmp_path):
         rdata.read_tfrecords(str(files[0])).materialize()
 
 
+def test_tfrecords_data_crc_detects_payload_corruption(tmp_path):
+    """A flipped PAYLOAD byte leaves the length field (and its CRC)
+    intact — only the per-record data CRC can catch it."""
+    from ray_tpu.data.tfrecords import read_records
+
+    rdata.range(10).write_tfrecords(str(tmp_path / "t"))
+    files = list((tmp_path / "t").iterdir())
+    raw = bytearray(files[0].read_bytes())
+    # Record layout: u64 length | u32 length-CRC | data | u32 data-CRC —
+    # offset 12 is the first data byte of the first record.
+    raw[12] ^= 0xFF
+    files[0].write_bytes(bytes(raw))
+    with pytest.raises(Exception, match="data CRC"):
+        rdata.read_tfrecords(str(files[0])).materialize()
+    # Opt-out path: check_integrity=False skips the data CRC and yields
+    # the (corrupt) payload without raising at the framing layer.
+    with open(files[0], "rb") as fh:
+        recs = list(read_records(fh, check_integrity=False))
+    assert len(recs) >= 1
+
+
 # -------------------------------------------------------------- filesystem
 def test_memory_filesystem_write_read_roundtrip():
     """Remote-fs-shaped path: write + read through memory:// URIs for
