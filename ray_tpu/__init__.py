@@ -12,7 +12,8 @@ NCCL integrations.
 Public API parity map (reference python/ray/__init__.py [unverified]):
 init/shutdown, @remote, get/put/wait/cancel/kill, ObjectRef, ActorHandle,
 get_actor, runtime context, plus subpackages dag/, data/, train/, tune/,
-serve/, rl/ (rllib), collective/, util/.
+serve/, rl/ (rllib), workflow/ (durable crash-resumable step DAGs),
+collective/, util/.
 """
 
 from ray_tpu._private.config import GlobalConfig as _config  # noqa: F401

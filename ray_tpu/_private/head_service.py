@@ -52,6 +52,11 @@ from ray_tpu._private.transport import (
     write_token,
 )
 
+try:
+    import fcntl
+except ImportError:  # pragma: no cover — non-POSIX: fence unavailable
+    fcntl = None
+
 DEFAULT_PORT = 6380
 
 _HEARTBEAT_PERIOD_S = 0.5
@@ -176,16 +181,64 @@ class _StateLog:
     record into a fresh file and atomically renames it over the log
     (``rewrite``), so a long-lived cluster's log stays proportional to
     its live state, not its history.
+
+    Single-writer fence: opening the log takes an exclusive ``flock``
+    on a sidecar ``<path>.lock`` (the sidecar, because compaction
+    replaces the log's inode — a lock on the log fd itself would not
+    cover the rewritten file). A standby promoting over the SHARED log
+    therefore blocks here until the old primary's lock releases —
+    which the kernel does only when that process actually exits — so a
+    stalled-but-alive primary can never interleave appends with the
+    promoted standby's (ADVICE round 5: split-brain fence). The lock
+    is acquired BEFORE replay, so replay never races a dying writer's
+    tail either.
     """
 
     _LEN = struct.Struct(">I")
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, lock_timeout: Optional[float] = None):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lockf = open(path + ".lock", "ab")
+        self._acquire_fence(lock_timeout)
         self._f = open(path, "ab")
         self._lock = threading.Lock()
         self.appended = 0  # records since open/compaction
+
+    def _acquire_fence(self, timeout: Optional[float]) -> None:
+        """Exclusive writer lock; ``timeout=None`` waits for the prior
+        writer to die (the standby-promotion semantics)."""
+        if fcntl is None:
+            return
+        import errno
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        warned = False
+        while True:
+            try:
+                fcntl.flock(self._lockf.fileno(),
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return
+            except OSError as exc:
+                if exc.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
+                                     errno.EACCES):
+                    # Not "held by another writer" — e.g. ENOLCK on an
+                    # NFS mount without lockd. Spinning forever would
+                    # mask the real failure; surface it.
+                    self._lockf.close()
+                    raise
+                if deadline is not None and time.monotonic() >= deadline:
+                    self._lockf.close()
+                    raise RuntimeError(
+                        f"state log {self.path!r} is held by another "
+                        f"live head process — refusing to serve over a "
+                        f"fenced log") from None
+                if not warned:
+                    warned = True
+                    print(f"ray_tpu head waiting for state-log lock "
+                          f"{self.path}.lock (prior writer still "
+                          f"alive)", flush=True)
+                time.sleep(0.2)
 
     def append(self, record: tuple):
         data = pack(record)
@@ -233,6 +286,10 @@ class _StateLog:
     def close(self):
         with self._lock:
             self._f.close()
+            try:
+                self._lockf.close()  # releases the writer fence
+            except OSError:
+                pass
 
 
 class HeadService:
@@ -268,8 +325,11 @@ class HeadService:
         self._compact_pending = False
         self._log: Optional[_StateLog] = None
         if state_path:
-            self._restore(state_path)
+            # Fence FIRST (blocks until any prior writer is truly
+            # dead), then replay: the log cannot grow a tail under us
+            # between replay and serving.
             self._log = _StateLog(state_path)
+            self._restore(state_path)
         # Batched control RPCs: a client's coalescer ships N requests in
         # one frame; sub-requests dispatch CONCURRENTLY here so a batch
         # of relays (task_push / task_done / chunk reads) overlaps their

@@ -519,6 +519,14 @@ def _try_global_worker() -> Optional[Worker]:
     return _global_worker
 
 
+def try_live_worker() -> Optional[Worker]:
+    """The global worker iff one is up AND alive — the runtime-discovery
+    check the KV-backed planes (memory:// filesystem, workflow journal)
+    share."""
+    w = _global_worker
+    return w if w is not None and w.is_alive else None
+
+
 def global_worker() -> Worker:
     if _global_worker is None:
         raise RayTpuError(

@@ -8,6 +8,7 @@ Endpoints:
 - ``GET /api/snapshot`` full cluster snapshot as JSON
 - ``GET /api/tasks``    task states (state API passthrough)
 - ``GET /api/actors``   actor states
+- ``GET /api/workflows`` durable workflow states (journal view)
 """
 
 from __future__ import annotations
@@ -39,6 +40,7 @@ async function refresh() {
     '<h2>tasks</h2>' + table(s.tasks) +
     '<h2>actors</h2>' + table(s.actors) +
     '<h2>object store</h2>' + table(s.object_store) +
+    '<h2>workflows</h2>' + table(s.workflows) +
     '<h2>workers</h2>' + table(s.workers);
 }
 refresh(); setInterval(refresh, 2000);
@@ -73,6 +75,7 @@ def _snapshot() -> dict:
             "python_store_objects": len(getattr(w.store, "_entries", {})),
             "shm": shm,
         },
+        "workflows": _workflow_summary(),
         "workers": {
             "mode": w.worker_mode,
             "pool_size": pool.size if pool is not None else 0,
@@ -81,6 +84,24 @@ def _snapshot() -> dict:
         },
         "actors_detail": list_actors(limit=100),
     }
+
+
+def _workflow_summary() -> dict:
+    """Durable-workflow panel: per-status counts plus the most recently
+    updated entries (journal view; empty when no storage root has been
+    touched this process)."""
+    try:
+        from ray_tpu.util.state import list_workflows, summarize_workflows
+
+        rows = list_workflows(limit=1000)
+        recent = sorted(rows, key=lambda r: r.updated_at or 0.0,
+                        reverse=True)[:10]
+        return {
+            "summary": summarize_workflows(rows),
+            "recent": {r.workflow_id: r.status for r in recent},
+        }
+    except Exception as exc:  # noqa: BLE001 — panel must not kill page
+        return {"error": repr(exc)}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -103,6 +124,13 @@ class _Handler(BaseHTTPRequestHandler):
 
                 payload = json.dumps(list_actors(limit=1000),
                                      default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/workflows"):
+                from ray_tpu.util.state import list_workflows
+
+                payload = json.dumps(
+                    [w.__dict__ for w in list_workflows(limit=1000)],
+                    default=str).encode()
                 ctype = "application/json"
             else:
                 payload = _PAGE.encode()

@@ -25,12 +25,45 @@ class Filesystem:
     # cannot alias each other's keys.
     keeps_scheme = False
 
+    # True when put_if_absent is a REAL atomic create (O_EXCL, KV
+    # overwrite=False). Consumers needing single-winner semantics
+    # (workflow commit markers) check this to degrade loudly instead
+    # of silently on best-effort backends.
+    atomic_put_if_absent = False
+
     def open(self, path: str, mode: str = "rb"):
         raise NotImplementedError
 
     def listdir(self, path: str) -> List[str]:
         """Recursive FILE listing under a directory path."""
         raise NotImplementedError
+
+    def children(self, path: str) -> List[str]:
+        """IMMEDIATE child names under a directory (one path segment,
+        files and subdirs alike). Default derives from the recursive
+        listing; backends with a cheap shallow scan override it."""
+        base = path.rstrip("/") + "/"
+        names = set()
+        for key in self.listdir(path.rstrip("/")):
+            rel = key[len(base):] if key.startswith(base) else None
+            if rel:
+                names.add(rel.split("/", 1)[0])
+        return sorted(names)
+
+    def put_if_absent(self, path: str, data: bytes) -> bool:
+        """Atomically create `path` with `data` iff it does not exist;
+        True when this call created it (commit-marker semantics).
+        Backends without an exclusive-create primitive fall back to
+        exists+write+read-back — best effort, not atomic."""
+        if self.exists(path):
+            return False
+        with self.open(path, "wb") as f:
+            f.write(data)
+        try:
+            with self.open(path, "rb") as f:
+                return f.read() == data
+        except OSError:
+            return False
 
     def makedirs(self, path: str) -> None:
         raise NotImplementedError
@@ -43,6 +76,8 @@ class Filesystem:
 
 
 class LocalFilesystem(Filesystem):
+    atomic_put_if_absent = True  # O_EXCL
+
     def open(self, path, mode="rb"):
         return open(path, mode)
 
@@ -51,6 +86,26 @@ class LocalFilesystem(Filesystem):
         for root, _, files in os.walk(path):
             out.extend(os.path.join(root, f) for f in files)
         return sorted(out)
+
+    def children(self, path):
+        try:
+            return sorted(e.name for e in os.scandir(path))
+        except OSError:
+            return []
+
+    def put_if_absent(self, path, data):
+        try:
+            with open(path, "xb") as f:  # O_EXCL: kernel-atomic create
+                f.write(data)
+            return True
+        except FileExistsError:
+            return False
+
+    def delete(self, path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
     def makedirs(self, path):
         os.makedirs(path, exist_ok=True)
@@ -81,6 +136,7 @@ class MemoryFilesystem(Filesystem):
     process-local dict otherwise."""
 
     keeps_scheme = True  # keys stay scheme-qualified in the shared store
+    atomic_put_if_absent = True  # KV overwrite=False under the head lock
     _KV_PREFIX = b"memfs|"
     _store: Dict[str, bytes] = {}  # no-runtime fallback
     _lock = threading.Lock()
@@ -88,10 +144,9 @@ class MemoryFilesystem(Filesystem):
     @staticmethod
     def _worker():
         try:
-            from ray_tpu._private.worker import _try_global_worker
+            from ray_tpu._private.worker import try_live_worker
 
-            w = _try_global_worker()
-            return w if w is not None and w.is_alive else None
+            return try_live_worker()
         except Exception:  # noqa: BLE001 — interpreter teardown
             return None
 
@@ -102,6 +157,20 @@ class MemoryFilesystem(Filesystem):
             return
         with self._lock:
             self._store[key] = data
+
+    def put_if_absent(self, path, data):
+        path = path.rstrip("/")
+        w = self._worker()
+        if w is not None:
+            # overwrite=False is decided under the KV's own lock (the
+            # head serializes it cluster-wide): a real atomic create.
+            return bool(w.kv_put(self._KV_PREFIX + path.encode(), data,
+                                 overwrite=False))
+        with self._lock:
+            if path in self._store:
+                return False
+            self._store[path] = data
+            return True
 
     def _get(self, key: str):
         w = self._worker()
@@ -174,6 +243,17 @@ class _FsspecFilesystem(Filesystem):
             for p in self._fs.find(path)
             if not self._fs.isdir(p))
 
+    def children(self, path):
+        # Delimiter-based shallow listing — a recursive find() over a
+        # big prefix just to learn immediate child names would hammer
+        # object-store LIST.
+        try:
+            return sorted(
+                p.rstrip("/").rsplit("/", 1)[-1]
+                for p in self._fs.ls(path, detail=False))
+        except (OSError, FileNotFoundError):
+            return []
+
     def makedirs(self, path):
         self._fs.makedirs(path, exist_ok=True)
 
@@ -182,6 +262,12 @@ class _FsspecFilesystem(Filesystem):
 
     def isdir(self, path):
         return self._fs.isdir(path)
+
+    def delete(self, path):
+        try:
+            self._fs.rm_file(path)
+        except Exception:  # noqa: BLE001 — dir-shaped or already gone
+            self._fs.rm(path, recursive=True)
 
 
 _REGISTRY: Dict[str, Filesystem] = {

@@ -55,10 +55,25 @@ def prepare_model(model):
                 np.asarray(synced[offset:offset + n])).reshape(p.shape))
             offset += n
 
-    # 2. Gradient averaging: one fused allreduce per backward pass,
-    # fired when the LAST parameter's grad lands.
+    # 2. Gradient averaging: one fused allreduce per backward pass.
+    # Completion is tracked PER BACKWARD PASS, not by counting hook
+    # arrivals: the first hook to fire queues an autograd engine
+    # callback that runs once the whole backward graph finishes. A
+    # counter (len(params) arrivals) desyncs permanently the first time
+    # any parameter receives no grad — frozen layer, unused branch,
+    # conditional model path — and then fires mid-backward forever
+    # after. The engine callback is immune: it runs exactly once per
+    # backward regardless of how many hooked params participated
+    # (params with no grad contribute zeros to the fused mean, matching
+    # DDP's find_unused_parameters=True).
+    #
+    # Limitation (document-level parity with DDP): if a rank runs a
+    # backward in which NO hooked parameter receives a grad, that rank
+    # skips its allreduce while the others block in theirs — the same
+    # hang torch DDP has without find_unused_parameters. Keep at least
+    # one shared parameter on every backward path.
     params = [p for p in model.parameters() if p.requires_grad]
-    state = {"arrived": 0}
+    state = {"queued": False}
 
     def _sync_all():
         with _torch.no_grad():
@@ -78,11 +93,21 @@ def prepare_model(model):
                     p.grad.copy_(g)
                 off += n
 
-    def _hook(_param):
-        state["arrived"] += 1
-        if state["arrived"] == len(params):
-            state["arrived"] = 0
+    def _finalize():
+        # Dedupe guard INSIDE the callback, not the hook: every hook
+        # queues a callback, only the first to run syncs. A failed
+        # backward (OOM, raising autograd Function) drops its queued
+        # callbacks without running them — gating the QUEUEING on the
+        # flag would then disable syncing forever; gating the SYNC
+        # recovers on the next backward's fresh callbacks.
+        if state["queued"]:
+            state["queued"] = False
             _sync_all()
+
+    def _hook(_param):
+        state["queued"] = True
+        _torch.autograd.Variable._execution_engine.queue_callback(
+            _finalize)
 
     for p in params:
         p.register_post_accumulate_grad_hook(_hook)

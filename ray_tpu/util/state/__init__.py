@@ -28,6 +28,14 @@ class ActorState:
 
 
 @dataclass
+class WorkflowState:
+    workflow_id: str
+    status: str
+    root: str
+    updated_at: Optional[float]
+
+
+@dataclass
 class ObjectState:
     object_id: str
     ready: bool
@@ -82,6 +90,36 @@ def list_objects(filters: Optional[List] = None,
         if len(out) >= limit:
             break
     return out
+
+
+def list_workflows(filters: Optional[List] = None,
+                   limit: int = 1000) -> List[WorkflowState]:
+    """Durable workflows under the process-global workflow storage
+    root (set by ``workflow.init`` or the first run/resume)."""
+    from ray_tpu.workflow.api import _ensure_storage
+
+    out: List[WorkflowState] = []
+    for rec in _ensure_storage(None).list_workflows():
+        st = WorkflowState(
+            workflow_id=rec.get("workflow_id", "?"),
+            status=rec.get("status", "?"),
+            root=rec.get("root", ""),
+            updated_at=rec.get("updated_at"))
+        if _matches(st, filters):
+            out.append(st)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def summarize_workflows(
+        workflows: Optional[List[WorkflowState]] = None) -> Dict[str, int]:
+    """Per-status workflow counts; pass an existing ``list_workflows``
+    result to avoid a second storage scan."""
+    counts: Dict[str, int] = {}
+    for wf in (workflows if workflows is not None else list_workflows()):
+        counts[wf.status] = counts.get(wf.status, 0) + 1
+    return counts
 
 
 def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:

@@ -1,0 +1,59 @@
+"""ray_tpu.workflow — durable, crash-resumable workflows (reference
+role: python/ray/workflow — the only SURVEY §1 L11 library the repo
+lacked).
+
+A workflow is a DAG of ``@workflow.step`` functions executed through
+the normal task plane, with every step's output committed to a
+``WorkflowStorage`` (local dir, ``memory://`` over the head KV, any
+fsspec URI) before dependents run. Kill -9 the driver — or the head —
+mid-run, and ``workflow.resume(workflow_id)`` replays the journal,
+skips committed steps (exactly-once via idempotency tokens checked at
+commit), and re-executes only the frontier. ``resume_all()`` sweeps
+every interrupted workflow after a reattach. Durable virtual actors
+snapshot named stateful objects through the same storage.
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def fetch(): ...
+    @workflow.step(max_retries=3, backoff_s=0.5)
+    def train(data): ...
+
+    dag = train.bind(fetch.bind())
+    workflow.run(dag, workflow_id="nightly", storage="/data/workflows")
+    # after a crash, from any process:
+    workflow.resume("nightly", storage="/data/workflows")
+"""
+
+from ray_tpu.workflow.api import (
+    FAILED,
+    RUNNING,
+    SUCCESS,
+    StepNode,
+    WorkflowStepFunction,
+    delete,
+    get_metadata,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    resume_all,
+    run,
+    run_async,
+    step,
+)
+from ray_tpu.workflow.storage import WorkflowStorage
+from ray_tpu.workflow.virtual_actor import (
+    VirtualActorClass,
+    VirtualActorHandle,
+    virtual_actor,
+)
+
+__all__ = [
+    "FAILED", "RUNNING", "SUCCESS", "StepNode", "VirtualActorClass",
+    "VirtualActorHandle", "WorkflowStepFunction", "WorkflowStorage",
+    "delete", "get_metadata", "get_output", "get_status", "init",
+    "list_all", "resume", "resume_all", "run", "run_async", "step",
+    "virtual_actor",
+]

@@ -1,0 +1,220 @@
+"""Workflow executor: drives a step DAG over the normal task plane with
+journal-checked, exactly-once step commits (reference role:
+python/ray/workflow/workflow_executor.py + step_executor.py
+[unverified]).
+
+Execution walks the DAG in deterministic topological order. For each
+step the journal is consulted FIRST: a committed step never re-executes
+— its stored output stands in (loaded lazily: a resume over a 200-step
+journal of committed steps touches only the outputs the frontier
+actually consumes, so resume latency scales with the frontier, not the
+history). Uncommitted steps submit through ``ray_tpu``'s scheduler /
+worker plane as ordinary tasks — upstream outputs pass as ObjectRefs
+(no re-serialization between live steps) — and their results commit
+durably before any dependent runs.
+
+Failure policy is per step: ``max_retries`` re-executions filtered by
+``retry_exceptions`` with exponential ``backoff_s``, then either
+``catch_exceptions`` (the committed output becomes a
+``(result, error)`` continuation pair) or workflow failure.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+from ray_tpu.dag.dag_node import DAGNode, InputNode, MultiOutputNode
+from ray_tpu.workflow.api import StepNode
+from ray_tpu.workflow.storage import (
+    FAILED,
+    SUCCESS,
+    WorkflowStorage,
+)
+
+_BACKOFF_CAP_S = 30.0
+
+
+def step_ids_for(dag: DAGNode) -> List[Tuple[str, DAGNode]]:
+    """Deterministic ``(step_id, node)`` assignment.
+
+    Ids derive from the node's position in the DAG's topological order
+    plus its step name. ``topological_order`` is a deterministic
+    structural walk, and the DAG is persisted at first run — so a
+    resume in a fresh process (unpickling the same structure) assigns
+    the SAME ids and the journal lines up.
+    """
+    out = []
+    for idx, node in enumerate(dag.topological_order()):
+        if isinstance(node, InputNode):
+            raise TypeError(
+                "workflows are self-contained: InputNode is not allowed "
+                "in a workflow DAG — bind concrete arguments instead")
+        if isinstance(node, StepNode):
+            out.append((f"{idx:04d}_{node.step_name}", node))
+        elif isinstance(node, MultiOutputNode):
+            out.append((f"{idx:04d}_multi_output", node))
+        else:
+            raise TypeError(
+                f"workflow DAGs are built from @workflow.step functions; "
+                f"got {type(node).__name__} — wrap the function with "
+                f"@workflow.step")
+    return out
+
+
+class _Committed:
+    """Lazy stand-in for a committed step's stored output."""
+
+    __slots__ = ("step_id",)
+
+    def __init__(self, step_id: str):
+        self.step_id = step_id
+
+
+class WorkflowExecutor:
+    def __init__(self, storage: WorkflowStorage, workflow_id: str):
+        self.storage = storage
+        self.workflow_id = workflow_id
+        self.steps_executed = 0
+        self.steps_skipped = 0
+
+    # ------------------------------------------------------------ helpers
+    def _materialize(self, cache: Dict[int, Any], node: DAGNode):
+        """Turn a cached upstream entry into something a task plane can
+        consume: committed placeholders load from storage exactly when
+        first needed and are put into the object store once."""
+        val = cache[id(node)]
+        if isinstance(val, _Committed):
+            import ray_tpu
+
+            loaded = self.storage.load_step_output(
+                self.workflow_id, val.step_id)
+            val = ray_tpu.put(loaded)
+            cache[id(node)] = val  # one load per resumed consumer set
+        return val
+
+    def _resolve_args(self, cache: Dict[int, Any], node: DAGNode):
+        args = tuple(
+            self._materialize(cache, a) if isinstance(a, DAGNode) else a
+            for a in node._bound_args)
+        kwargs = {
+            k: self._materialize(cache, v) if isinstance(v, DAGNode) else v
+            for k, v in node._bound_kwargs.items()}
+        return args, kwargs
+
+    @staticmethod
+    def _retryable(exc: BaseException, retry_exceptions) -> bool:
+        if retry_exceptions is True:
+            return isinstance(exc, Exception)
+        if not retry_exceptions:
+            return False
+        return isinstance(exc, tuple(retry_exceptions) if isinstance(
+            retry_exceptions, (list, tuple)) else retry_exceptions)
+
+    def _run_step(self, step_id: str, node: StepNode,
+                  cache: Dict[int, Any]) -> Any:
+        """Execute one step through the task plane with the step's
+        retry/backoff/catch policy; returns the VALUE to commit."""
+        import ray_tpu
+        from ray_tpu.remote_function import RemoteFunction
+
+        opts = node._step_options
+        task_opts: Dict[str, Any] = {
+            "name": f"workflow:{self.workflow_id}:{step_id}",
+            # The executor owns retries (durable attempt accounting +
+            # backoff); the scheduler must not retry underneath it.
+            "max_retries": 0,
+        }
+        for k in ("num_cpus", "num_tpus", "num_gpus", "resources"):
+            if opts.get(k) is not None:
+                task_opts[k] = opts[k]
+        fn = RemoteFunction(node._fn, task_opts)
+        args, kwargs = self._resolve_args(cache, node)
+        max_retries = int(opts.get("max_retries", 0) or 0)
+        retry_exceptions = opts.get("retry_exceptions", True)
+        backoff_s = float(opts.get("backoff_s", 0.1) or 0.0)
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                value = ray_tpu.get(fn.remote(*args, **kwargs))
+                self._last_attempts = attempts
+                if opts.get("catch_exceptions"):
+                    return (value, None)
+                return value
+            except Exception as exc:  # noqa: BLE001 — step boundary
+                if attempts <= max_retries and \
+                        self._retryable(exc, retry_exceptions):
+                    if backoff_s > 0:
+                        time.sleep(min(
+                            backoff_s * (2 ** (attempts - 1)),
+                            _BACKOFF_CAP_S))
+                    continue
+                self._last_attempts = attempts
+                if opts.get("catch_exceptions"):
+                    # Commit the ORIGINAL exception, not the task-error
+                    # wrapper: the wrapper's dynamically-derived type
+                    # does not survive pickling, the cause does.
+                    cause = getattr(exc, "cause", None)
+                    return (None, cause if isinstance(
+                        cause, BaseException) else exc)
+                raise
+
+    # ------------------------------------------------------------ execute
+    def execute(self, dag: DAGNode) -> Any:
+        assigned = step_ids_for(dag)
+        cache: Dict[int, Any] = {}
+        try:
+            for step_id, node in assigned:
+                if self.storage.step_commit_record(
+                        self.workflow_id, step_id) is not None:
+                    cache[id(node)] = _Committed(step_id)
+                    self.steps_skipped += 1
+                    continue
+                if isinstance(node, MultiOutputNode):
+                    value = [
+                        self._value_of(cache, a)
+                        for a in node._bound_args]
+                    self._last_attempts = 1
+                    t0 = time.monotonic()
+                else:
+                    t0 = time.monotonic()
+                    value = self._run_step(step_id, node, cache)
+                won, marker = self.storage.commit_step(
+                    self.workflow_id, step_id, value, meta={
+                        "attempts": self._last_attempts,
+                        "duration_s": round(time.monotonic() - t0, 6),
+                        "name": getattr(node, "step_name",
+                                        "multi_output"),
+                    })
+                if not won:
+                    # A racing resume committed first: its output is the
+                    # canonical one (exactly-once) — adopt it.
+                    cache[id(node)] = _Committed(step_id)
+                else:
+                    import ray_tpu
+
+                    cache[id(node)] = ray_tpu.put(value)
+                self.steps_executed += 1
+        except Exception as exc:
+            self.storage.set_status(self.workflow_id, FAILED,
+                                    error=repr(exc))
+            raise
+        final = self._value_of(cache, dag)
+        self.storage.save_result(self.workflow_id, final)
+        self.storage.set_status(self.workflow_id, SUCCESS)
+        return final
+
+    def _value_of(self, cache: Dict[int, Any], node: DAGNode) -> Any:
+        """A node's concrete VALUE (committed output loaded, live ref
+        resolved)."""
+        val = cache[id(node)]
+        if isinstance(val, _Committed):
+            return self.storage.load_step_output(
+                self.workflow_id, val.step_id)
+        import ray_tpu
+        from ray_tpu._private.worker import ObjectRef
+
+        if isinstance(val, ObjectRef):
+            return ray_tpu.get(val)
+        return val

@@ -4,10 +4,11 @@ import json
 import urllib.request
 
 import ray_tpu
+from ray_tpu import workflow
 from ray_tpu.dashboard import start_dashboard, stop_dashboard
 
 
-def test_dashboard_snapshot_and_page(ray_start_regular):
+def test_dashboard_snapshot_and_page(ray_start_regular, tmp_path):
     @ray_tpu.remote
     def f(x):
         return x + 1
@@ -20,6 +21,15 @@ def test_dashboard_snapshot_and_page(ray_start_regular):
     assert ray_tpu.get(f.remote(1)) == 2
     a = A.options(name="dash_actor").remote()
     assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    # A completed workflow must show up in the workflows panel.
+    workflow.init(str(tmp_path / "wf"))
+
+    @workflow.step
+    def one():
+        return 1
+
+    assert workflow.run(one.bind(), workflow_id="dash_wf") == 1
 
     dash = start_dashboard(port=0)
     try:
@@ -37,5 +47,14 @@ def test_dashboard_snapshot_and_page(ray_start_regular):
                                     timeout=10) as r:
             actors_raw = r.read().decode()
         assert "dash_actor" in actors_raw or "A" in actors_raw
+        # Workflows panel: per-status summary in the snapshot + the
+        # dedicated endpoint listing the journal.
+        assert snap["workflows"]["summary"].get("SUCCESS", 0) >= 1
+        assert snap["workflows"]["recent"].get("dash_wf") == "SUCCESS"
+        with urllib.request.urlopen(dash.url + "/api/workflows",
+                                    timeout=10) as r:
+            rows = json.loads(r.read())
+        assert any(w["workflow_id"] == "dash_wf"
+                   and w["status"] == "SUCCESS" for w in rows)
     finally:
         stop_dashboard()

@@ -360,6 +360,33 @@ def test_head_standby_failover(tmp_path):
         os.environ.pop("RAY_TPU_CLUSTER_TOKEN", None)
 
 
+def test_state_log_write_fence(tmp_path):
+    """The append-log refuses a second live writer: a promoted standby
+    (or an operator double-start) cannot interleave appends with a
+    stalled-but-alive primary — the flock fence serializes them on
+    actual process/handle death (ADVICE round 5)."""
+    from ray_tpu._private.head_service import _StateLog, fcntl
+
+    if fcntl is None:
+        pytest.skip("no fcntl on this platform")
+    path = str(tmp_path / "state.log")
+    primary = _StateLog(path)
+    primary.append(("kv_put", b"k", b"v"))
+    # A second writer on the SAME log must not acquire the fence while
+    # the first is alive (flock: separate fds conflict even in-process).
+    with pytest.raises(RuntimeError):
+        _StateLog(path, lock_timeout=0.5)
+    # Compaction keeps the fence (the sidecar survives the inode swap).
+    primary.rewrite(("snapshot", [(b"k", b"v")], [], [], [], []))
+    with pytest.raises(RuntimeError):
+        _StateLog(path, lock_timeout=0.5)
+    primary.close()
+    # Writer gone: the next head (standby promotion) acquires and serves.
+    successor = _StateLog(path, lock_timeout=0.5)
+    assert [r for r in _StateLog.replay(path)][0][0] == "snapshot"
+    successor.close()
+
+
 def test_head_client_close_frees_data_plane(head_proc):
     """HeadClient.close() must shut down the direct object server and
     peer pool — the listener port is released, not leaked."""

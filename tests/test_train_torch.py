@@ -71,6 +71,60 @@ def test_torch_trainer_dp_learns_and_stays_synced():
         digest
 
 
+def test_unused_branch_does_not_desync_allreduce():
+    """A parameter that requires_grad but receives NO grad (unused
+    branch) must not desync the fused allreduce: completion is tracked
+    per backward pass, so every backward still fires exactly one sync
+    and the replicas stay in lockstep (the old arrival counter never
+    reached len(params) and silently stopped syncing)."""
+
+    def loop():
+        import torch
+        import torch.nn as nn
+
+        from ray_tpu.train.torch import prepare_model
+
+        ctx = session.get_context()
+        torch.manual_seed(7 + ctx.get_world_rank())
+
+        class TwoHead(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.used = nn.Linear(4, 1)
+                self.unused = nn.Linear(4, 1)  # requires_grad, no grad
+
+            def forward(self, x):
+                return self.used(x)
+
+        model = prepare_model(TwoHead())
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        rng = np.random.default_rng(ctx.get_world_rank())
+        w_true = np.asarray([1.0, -2.0, 3.0, 0.5], dtype=np.float32)
+        x = rng.normal(size=(256, 4)).astype(np.float32)
+        y = x @ w_true
+        xt, yt = torch.from_numpy(x), torch.from_numpy(y[:, None])
+        for _ in range(40):
+            opt.zero_grad()
+            nn.functional.mse_loss(model(xt), yt).backward()
+            opt.step()
+        used = np.concatenate(
+            [p.detach().numpy().reshape(-1)
+             for p in model.used.parameters()])
+        session.report({
+            "rank": ctx.get_world_rank(),
+            "used_digest": [float(v) for v in used],
+        })
+
+    trainer = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    # Rank-dependent data fits only if gradient averaging kept firing:
+    # without a per-pass sync the replicas silently diverge.
+    digest = np.asarray(result.metrics["used_digest"])
+    assert np.allclose(digest[:4], [1.0, -2.0, 3.0, 0.5], atol=0.15), \
+        digest
+
+
 def test_prepare_data_loader_shards_per_rank():
     def loop():
         import torch

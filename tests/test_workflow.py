@@ -1,0 +1,469 @@
+"""Durable workflow tests: crash-resumable exactly-once step execution
+(reference model: python/ray/workflow tests — recovery, step retries,
+virtual actors). The acceptance scenario: kill -9 the driver mid-
+workflow, resume() from a fresh process, and prove with persisted
+side-effect counters that committed steps never re-execute."""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def runtime():
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=2, worker_mode="thread",
+                          ignore_reinit_error=True)
+    yield worker
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def root(tmp_path):
+    return str(tmp_path / "wf_storage")
+
+
+# --------------------------------------------------------------- basics
+def test_run_diamond_and_introspect(runtime, root):
+    @workflow.step
+    def src():
+        return 10
+
+    @workflow.step
+    def double(x):
+        return 2 * x
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    s = src.bind()
+    dag = add.bind(double.bind(s), double.bind(s))
+    out = workflow.run(dag, workflow_id="diamond", storage=root)
+    assert out == 40
+    assert workflow.get_status("diamond", storage=root) == \
+        workflow.SUCCESS
+    assert workflow.get_output("diamond", storage=root) == 40
+    assert ("diamond", workflow.SUCCESS) in workflow.list_all(
+        storage=root)
+    meta = workflow.get_metadata("diamond", storage=root)
+    assert len(meta["steps"]) == 4
+    assert all(rec and rec["attempts"] == 1
+               for rec in meta["steps"].values())
+
+
+def test_completed_steps_skip_on_rerun(runtime, root, tmp_path):
+    counts = str(tmp_path / "side_effects")
+
+    @workflow.step
+    def effect(tag, prev=None):
+        with open(counts, "a") as f:
+            f.write(tag + "\n")
+        return tag
+
+    dag = effect.bind("b", effect.bind("a"))
+    assert workflow.run(dag, workflow_id="rerun", storage=root) == "b"
+    # Re-running a completed workflow returns the stored result with
+    # ZERO re-executions.
+    assert workflow.run(dag, workflow_id="rerun", storage=root) == "b"
+    assert workflow.resume("rerun", storage=root) == "b"
+    with open(counts) as f:
+        assert sorted(f.read().split()) == ["a", "b"]
+
+
+def test_step_retries_with_backoff(runtime, root, tmp_path):
+    attempts = str(tmp_path / "attempts")
+
+    @workflow.step(max_retries=3, retry_exceptions=(ValueError,),
+                   backoff_s=0.01)
+    def flaky():
+        with open(attempts, "a") as f:
+            f.write("x")
+        if os.path.getsize(attempts) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    assert workflow.run(flaky.bind(), workflow_id="retry",
+                        storage=root) == "ok"
+    assert os.path.getsize(attempts) == 3  # 2 failures + 1 success
+    meta = workflow.get_metadata("retry", storage=root)
+    (rec,) = meta["steps"].values()
+    assert rec["attempts"] == 3
+
+
+def test_retry_exceptions_filter(runtime, root, tmp_path):
+    attempts = str(tmp_path / "attempts")
+
+    @workflow.step(max_retries=5, retry_exceptions=(ValueError,))
+    def wrong_kind():
+        with open(attempts, "a") as f:
+            f.write("x")
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        workflow.run(wrong_kind.bind(), workflow_id="filt",
+                     storage=root)
+    assert os.path.getsize(attempts) == 1  # no retries burned
+    assert workflow.get_status("filt", storage=root) == workflow.FAILED
+
+
+def test_catch_exceptions_continuation(runtime, root):
+    @workflow.step(catch_exceptions=True)
+    def boom():
+        raise RuntimeError("kaboom")
+
+    @workflow.step
+    def recover(pair):
+        result, err = pair
+        return "fallback" if err is not None else result
+
+    out = workflow.run(recover.bind(boom.bind()),
+                       workflow_id="catch", storage=root)
+    assert out == "fallback"
+    assert workflow.get_status("catch", storage=root) == \
+        workflow.SUCCESS
+
+
+def test_virtual_actor_durable(runtime, root):
+    @workflow.virtual_actor
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.get_or_create("acct", 100, storage=root)
+    assert c.incr.run() == 101
+    assert c.incr.run(9) == 110
+    # A fresh handle (fresh process in real life) rehydrates the last
+    # committed snapshot, not the constructor args.
+    c2 = Counter.get_or_create("acct", 0, storage=root)
+    assert c2.incr.run() == 111
+    assert c2.get_state() == {"n": 111}
+
+
+# ------------------------------------------------- crash-resume (tentpole)
+# Driver subprocess: runs a 10-step chain where each step appends its
+# tag to a side-effect log. Step KILL_AT blocks at its START (before
+# any side effect), so SIGKILLing the driver there is a clean step
+# boundary: steps 0..KILL_AT-1 committed exactly once, KILL_AT.. never
+# ran.
+_DRIVER = r"""
+import os, sys, time
+import ray_tpu
+from ray_tpu import workflow
+
+root, effects, hold, kill_at = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+address = sys.argv[5] if len(sys.argv) > 5 else None
+
+ray_tpu.init(num_cpus=2, worker_mode="thread", address=address or None)
+
+@workflow.step
+def link(i, prev=None):
+    if i == int(os.environ.get("WF_KILL_AT", "-1")):
+        while os.path.exists(os.environ["WF_HOLD"]):
+            time.sleep(0.02)
+    with open(os.environ["WF_EFFECTS"], "a") as f:
+        f.write(f"step{i}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return (prev or 0) + i
+
+os.environ["WF_KILL_AT"] = str(kill_at)
+os.environ["WF_HOLD"] = hold
+os.environ["WF_EFFECTS"] = effects
+
+node = None
+for i in range(10):
+    node = link.bind(i, node) if node is not None else link.bind(i)
+out = workflow.run(node, workflow_id="crashy", storage=root)
+print("RESULT:" + str(out), flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def _spawn_driver(root, effects, hold, kill_at, address=None, env=None):
+    args = [sys.executable, "-c", _DRIVER, root, effects, hold,
+            str(kill_at)]
+    if address:
+        args.append(address)
+    return subprocess.Popen(
+        args, stdout=subprocess.PIPE, text=True,
+        env=dict(env or os.environ))
+
+
+def _wait_for_lines(path, n, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                if len(f.read().split()) >= n:
+                    return
+        time.sleep(0.05)
+    raise AssertionError(f"{path} never reached {n} lines")
+
+
+def test_driver_kill9_resume_exactly_once(root, tmp_path):
+    """The acceptance scenario: a 10-step workflow survives kill -9 of
+    its driver at a random step boundary; resume() completes it with
+    ZERO re-executions of committed steps (persisted side-effect
+    counters prove exactly-once)."""
+    effects = str(tmp_path / "effects.log")
+    hold = str(tmp_path / "hold")
+    open(hold, "w").close()
+    kill_at = random.randrange(2, 9)
+
+    proc = _spawn_driver(root, effects, hold, kill_at)
+    try:
+        # Steps 0..kill_at-1 commit; step kill_at parks on the hold
+        # file before its side effect. Wait for the boundary, then
+        # SIGKILL: no atexit, no cleanup, only the journal remains.
+        _wait_for_lines(effects, kill_at)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if workflow.get_status(
+                    "crashy", storage=root) == workflow.RUNNING:
+                meta = workflow.get_metadata("crashy", storage=root)
+                done = sum(1 for r in meta["steps"].values() if r)
+                if done >= kill_at:
+                    break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    os.unlink(hold)
+
+    assert workflow.get_status("crashy", storage=root) == \
+        workflow.RUNNING  # interrupted, not failed
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+    try:
+        os.environ["WF_EFFECTS"] = effects
+        os.environ["WF_KILL_AT"] = "-1"
+        out = workflow.resume("crashy", storage=root)
+        assert out == sum(range(10))
+        assert workflow.get_status("crashy", storage=root) == \
+            workflow.SUCCESS
+        with open(effects) as f:
+            runs = f.read().split()
+        # Exactly-once: every step ran exactly one time across BOTH
+        # processes — the committed prefix was never re-executed.
+        assert sorted(runs) == [f"step{i}" for i in range(10)], runs
+    finally:
+        os.environ.pop("WF_EFFECTS", None)
+        os.environ.pop("WF_KILL_AT", None)
+        ray_tpu.shutdown()
+
+
+def test_resume_all_sweeps_interrupted(root, tmp_path):
+    """resume_all() discovers and completes every RUNNING (interrupted)
+    workflow under the root — the head-reattach recovery sweep."""
+    effects = str(tmp_path / "effects.log")
+    hold = str(tmp_path / "hold")
+    open(hold, "w").close()
+
+    proc = _spawn_driver(root, effects, hold, 3)
+    try:
+        _wait_for_lines(effects, 3)
+        time.sleep(0.3)  # let step 2's commit land
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    os.unlink(hold)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+    try:
+        os.environ["WF_EFFECTS"] = effects
+        os.environ["WF_KILL_AT"] = "-1"
+        results = workflow.resume_all(storage=root)
+        assert results == {"crashy": sum(range(10))}
+        assert workflow.list_all(
+            status_filter=workflow.RUNNING, storage=root) == []
+    finally:
+        os.environ.pop("WF_EFFECTS", None)
+        os.environ.pop("WF_KILL_AT", None)
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------- head-restart resume
+@pytest.mark.slow
+def test_workflow_resumes_after_head_restart(tmp_path):
+    """The second acceptance scenario: workflow state journaled on
+    ``memory://`` storage rides the head KV and its append-log. Kill -9
+    BOTH the driver and the head mid-workflow; restart the head from
+    the log, resume from a brand-new driver: committed steps are not
+    re-executed."""
+    state = str(tmp_path / "head_state.log")
+    effects = str(tmp_path / "effects.log")
+    hold = str(tmp_path / "hold")
+    open(hold, "w").close()
+    env = dict(os.environ)
+    env["RAY_TPU_HEAD_CLIENT_TIMEOUT_S"] = "3.0"
+
+    def spawn_head(port):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", str(port), "--state", state],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = proc.stdout.readline()
+        return proc, line.strip().rsplit(" ", 1)[-1]
+
+    ray_tpu.shutdown()
+    head1, address = spawn_head(0)
+    port = int(address.rsplit(":", 1)[1])
+    root = "memory://wf_head_restart"
+    driver = _spawn_driver(root, effects, hold, 4, address=address,
+                           env=env)
+    head2 = None
+    try:
+        _wait_for_lines(effects, 4)
+        time.sleep(0.5)  # step 3's commit reaches the head KV + log
+        driver.send_signal(signal.SIGKILL)
+        driver.wait(timeout=10)
+        head1.send_signal(signal.SIGKILL)
+        head1.wait(timeout=10)
+        os.unlink(hold)
+
+        head2, _ = spawn_head(port)
+        ray_tpu.init(num_cpus=2, worker_mode="thread", address=address,
+                     ignore_reinit_error=True)
+        os.environ["WF_EFFECTS"] = effects
+        os.environ["WF_KILL_AT"] = "-1"
+        deadline = time.time() + 30
+        status = None
+        while time.time() < deadline:
+            try:
+                status = workflow.get_status("crashy", storage=root)
+                if status is not None:
+                    break
+            except Exception:  # noqa: BLE001 — head still re-dialing
+                pass
+            time.sleep(0.25)
+        assert status == workflow.RUNNING
+        out = workflow.resume("crashy", storage=root)
+        assert out == sum(range(10))
+        with open(effects) as f:
+            runs = f.read().split()
+        assert sorted(runs) == [f"step{i}" for i in range(10)], runs
+    finally:
+        os.environ.pop("WF_EFFECTS", None)
+        os.environ.pop("WF_KILL_AT", None)
+        ray_tpu.shutdown()
+        for p in (driver, head1, head2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=5)
+
+
+# ----------------------------------------------------- commit protocol
+def test_commit_step_single_winner(root):
+    """Racing committers converge on ONE canonical commit: the marker
+    is an exclusive create, so exactly one caller wins and the loser
+    adopts the winner's token (the idempotency check at commit)."""
+    store = workflow.WorkflowStorage(root)
+    won_a, rec_a = store.commit_step("race", "0000_s", "value_a")
+    assert won_a is True
+    # A second committer (concurrent resume in real life) must LOSE and
+    # see the first commit's token; the stored output is untouched.
+    won_b, rec_b = store.commit_step("race", "0000_s", "value_b")
+    assert won_b is False
+    assert rec_b["token"] == rec_a["token"]
+    assert store.load_step_output("race", "0000_s") == "value_a"
+
+
+def test_virtual_actor_concurrent_writer_detected(runtime, root):
+    """Two live handles to the same virtual actor: the per-seq CAS
+    commit makes the slower writer fail loudly instead of silently
+    clobbering the faster one's committed state."""
+    @workflow.virtual_actor
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    a = Counter.get_or_create("dup", storage=root)
+    b = Counter.get_or_create("dup", storage=root)
+    assert a.incr.run() == 1
+    with pytest.raises(RuntimeError, match="concurrent"):
+        b.incr.run()  # b's seq-1 commit lost to a's
+    # A fresh handle sees a's committed state and proceeds.
+    c = Counter.get_or_create("dup", storage=root)
+    assert c.incr.run() == 2
+
+
+def test_virtual_actor_snapshots_bounded(runtime, root):
+    """Superseded snapshots are pruned after each commit: a hot actor's
+    storage footprint stays bounded, and rehydration still loads the
+    latest committed state."""
+    @workflow.virtual_actor
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.get_or_create("hot", storage=root)
+    for _ in range(20):
+        c.incr.run()
+    actor_dir = os.path.join(root, "virtual_actors", "hot")
+    markers = [f for f in os.listdir(actor_dir)
+               if f.startswith("commit.")]
+    states = [f for f in os.listdir(actor_dir) if f.startswith("state.")]
+    keep = workflow.WorkflowStorage.ACTOR_KEEP_SNAPSHOTS
+    assert len(markers) <= keep + 1, markers
+    assert len(states) <= keep + 2, states  # +1 in-flight tolerance
+    c2 = Counter.get_or_create("hot", storage=root)
+    assert c2.incr.run() == 21
+
+
+# ------------------------------------------------------------- validation
+def test_rejects_non_step_dags(runtime, root):
+    @ray_tpu.remote
+    def plain(x):
+        return x
+
+    with pytest.raises(TypeError):
+        workflow.run(plain.bind(1), workflow_id="bad", storage=root)
+
+    from ray_tpu.dag import InputNode
+
+    @workflow.step
+    def s(x):
+        return x
+
+    with InputNode() as inp:
+        dag = s.bind(inp)
+    with pytest.raises(TypeError):
+        workflow.run(dag, workflow_id="bad2", storage=root)
+
+
+def test_step_options_validation():
+    with pytest.raises(ValueError):
+        workflow.step(lambda: None, bogus_option=1)
+    wrapped = workflow.step(lambda: 1)
+    with pytest.raises(TypeError):
+        wrapped()  # direct calls are an error, like RemoteFunction
