@@ -702,11 +702,17 @@ def bench_control_plane(repeats=5):
         cross, paired = _marginal_times(
             "cp_cluster", 100, 1000, max(3, repeats - 2))
         rate, iqr, dropped = _rate_stats(cross, paired, 1)
+        # One extra full-width run just for the fast-path counters:
+        # relay eliminated from steady-state dispatch, function bytes
+        # shipped once per (node, digest), results inlined.
+        counters = {k: v for k, v in _run_probe("cp_cluster", 1000).items()
+                    if k not in ("wall_s", "n")}
         result["cluster_fanout_1k"] = {
             "tasks_per_sec": rate, "tasks_per_sec_iqr": iqr,
             "outlier_slopes_dropped": dropped,
             "repeats": max(3, repeats - 2),
             "task_latency_us": statistics.median(cross) * 1e6,
+            "counters": counters,
         }
     except Exception as e:  # noqa: BLE001 — cluster spin-up optional
         result["cluster_fanout_1k"] = {"skipped": repr(e)}
@@ -813,6 +819,7 @@ def _probe_main(args):
     import numpy as np
 
     n = args.probe_n
+    extra = {}  # probe-specific counters riding the JSON line
 
     if args.probe == "chain":
         compiled = _build_chain_dag()
@@ -926,12 +933,39 @@ def _probe_main(args):
             def noop(x):
                 return x
 
+            w = ray_tpu._private.worker.global_worker()
+            # Steady state starts once the node's direct server address
+            # has ridden a heartbeat into the directory (otherwise the
+            # first pushes measure the relay fallback, not the fast path).
+            deadline = time.perf_counter() + 5.0
+            while time.perf_counter() < deadline:
+                nodes = w.head_client.node_list()
+                if nodes and all(n_.get("peer_addr") for n_ in nodes):
+                    break
+                time.sleep(0.1)
             assert ray_tpu.get(noop.remote(41), timeout=60) == 41
             t0 = time.perf_counter()
             refs = [noop.remote(i) for i in range(n)]
             out = ray_tpu.get(refs, timeout=600)
             wall = time.perf_counter() - t0
             assert out == list(range(n))
+            r = w.remote_router
+            hc = w.head_client
+            extra = {
+                # Fast-path proof: head relay eliminated from steady-
+                # state dispatch, function bytes shipped once per node.
+                "direct_pushes": r.direct_pushes,
+                "relayed_pushes": r.relayed_pushes,
+                "push_round_trips": r.direct_batches,
+                "direct_done_reports": r.direct_done_reports,
+                "relayed_done_reports": r.relayed_done_reports,
+                "inline_results": r.inline_results,
+                "fn_payloads_with_bytes": r.fn_payloads_with_bytes,
+                "fn_payloads_digest_only": r.fn_payloads_digest_only,
+                "fn_bytes_sent": r.fn_bytes_sent,
+                "head_msgs": hc.req_msgs_sent,
+                "head_msgs_per_task": hc.req_msgs_sent / max(n, 1),
+            }
         finally:
             for p in reversed(procs):
                 p.kill()
@@ -964,7 +998,9 @@ def _probe_main(args):
         assert np.isfinite(final), final
     else:
         raise SystemExit(f"unknown probe {args.probe}")
-    print(json.dumps({"wall_s": wall, "n": n}))
+    out = {"wall_s": wall, "n": n}
+    out.update(extra)
+    print(json.dumps(out))
 
 
 def main():

@@ -152,6 +152,23 @@ _D("memory_monitor_threshold", float, 0.95,
 _D("spill_backlog_factor", float, 4.0,
    "Route tasks to remote node daemons when the local backlog exceeds "
    "factor times num_cpus and a feasible node is less loaded.")
+_D("dep_wait_s", float, 300.0,
+   "Bound on waiting for a task's dependency to be produced: the "
+   "driver-side wait before inlining a local value, and the node-side "
+   "pull-wait for a pending pull-ref shipped ahead of its producer. "
+   "Raises GetTimeoutError past it (RAY_TPU_DEP_WAIT_S).")
+_D("direct_dispatch", bool, True,
+   "Push tasks peer-to-peer to the target node daemon's direct server "
+   "(batched over the framed transport), falling back to a head-relayed "
+   "task_push only when the direct dial fails.")
+_D("locality_min_bytes", int, 64 * 1024,
+   "Locality-aware placement: prefer the feasible node already holding "
+   "at least this many bytes of a task's ref args over the least-loaded "
+   "node (pending deps count as presence at their target node).")
+_D("locality_load_slack", float, 8.0,
+   "Locality-aware placement: the bytes-resident node wins only while "
+   "its load is within this many backlog-per-CPU units of the "
+   "least-loaded feasible node (past it, spread wins over locality).")
 _D("external_pull_ttl_s", float, 600.0,
    "Bound on post-completion pull retries for remote actor-task results "
    "(mirrors the ActorHost result-pin TTL): past it the object is "

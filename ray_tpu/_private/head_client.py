@@ -436,6 +436,14 @@ class HeadClient:
     def object_announce(self, oid_bin: bytes):
         return self._request(("object_announce", oid_bin))
 
+    def object_announce_many(self, oid_bins) -> None:
+        """Announce N objects in one coalesced flight (the slots share
+        batch frames — ~1 round trip, not N)."""
+        slots = [self._request_async(("object_announce", ob))
+                 for ob in oid_bins]
+        for slot in slots:
+            self._request_result(slot)
+
     def object_pull(self, oid_bin: bytes) -> Optional[bytes]:
         """Pull a remote object's serialized bytes: direct peer-to-peer
         from the owner's object server when the head knows its address
@@ -492,9 +500,46 @@ class HeadClient:
     def task_push(self, target_client: str, payload: bytes):
         return self._request(("task_push", target_client, payload))
 
+    def task_push_many(self, target_client: str, payloads: list) -> list:
+        """Head-relayed task pushes, all in flight at once: the slots
+        ride shared coalescer batch frames, so N pushes cost ~1 round
+        trip. Per-payload results; a failed slot yields its exception
+        OBJECT instead of voiding its batch-mates."""
+        slots = [self._request_async(("task_push", target_client, p))
+                 for p in payloads]
+        out = []
+        for slot in slots:
+            try:
+                out.append(self._request_result(slot))
+            except Exception as exc:  # noqa: BLE001 — per-payload failure
+                out.append(exc)
+        return out
+
+    def task_push_direct(self, addr, payloads: list) -> list:
+        """Direct batched task pushes to a node daemon's object/request
+        server — the head stays out of steady-state dispatch. One
+        vectored write carries every payload; raises
+        ``PeerUnreachableError`` so callers fall back to the relay."""
+        return self._peers.call_many(
+            tuple(addr), [("task_push", p) for p in payloads])
+
     def task_done(self, driver_id: str, oid_bins, payload: bytes):
         return self._request(
             ("task_done", driver_id, tuple(oid_bins), payload))
+
+    def task_done_many(self, driver_id: str, entries) -> None:
+        """N relayed completion reports in one coalesced flight
+        (``entries`` = [(oid_bins, payload), ...]); per-entry failures
+        are swallowed — a gone driver forfeits its completions, the
+        results stay local either way."""
+        slots = [self._request_async(
+            ("task_done", driver_id, tuple(oid_bins), payload))
+            for oid_bins, payload in entries]
+        for slot in slots:
+            try:
+                self._request_result(slot)
+            except Exception:  # noqa: BLE001 — driver/head gone
+                pass
 
     def cluster_info(self) -> dict:
         return dict(self._request(("cluster_info",)))

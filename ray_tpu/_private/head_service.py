@@ -705,11 +705,16 @@ class HeadService:
                     "node_id": node_id, "resources": dict(resources)})
                 return ("ok", None)
             if kind == "node_list":
+                # peer_addr is the node's direct request/object server —
+                # drivers dial it once and push task batches peer-to-peer
+                # (direct dispatch), with task_push relay as the fallback.
                 with self._lock:
                     return ("ok", [
                         {"client_id": cl.client_id, "node_id": cl.node_id,
                          "resources": cl.resources, "alive": cl.alive,
-                         "status": cl.status}
+                         "status": cl.status,
+                         "peer_addr": (list(cl.peer_addr)
+                                       if cl.peer_addr else None)}
                         for cl in self._clients.values() if cl.is_node])
             if kind == "task_push":
                 _, target_client, payload = msg

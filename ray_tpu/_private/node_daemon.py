@@ -10,12 +10,21 @@ leased tasks, and an object manager serving its store's objects to peers
 - registers its node id + resource spec with the head's membership;
 - heartbeats its load (scheduler backlog) so drivers' routers can spill
   to the least-loaded feasible node;
-- serves ``task_push`` events: unpacks the wire task, pulls any ref args
-  it doesn't hold (head-relayed chunked pull from the owning node — the
-  driver stays out of the data path), executes through the normal local
-  scheduler (worker processes, retries, OOM kill), then reports
-  ``task_done`` with the result object ids — the bytes stay here until
-  someone pulls them;
+- serves ``task_push`` on TWO planes: the driver-dialed DIRECT plane
+  (this node's object/request server — batched framed pushes, the head
+  out of steady-state dispatch) and the head-relayed fallback (NAT'd
+  drivers). Either way the daemon unpacks the wire task, pulls any ref
+  args it doesn't hold (peer-to-peer chunked pull from the owning node,
+  waiting out pending pull-refs whose producer hasn't finished yet — the
+  owner-side barrier lives here, not on the driver), executes through
+  the normal local scheduler (worker processes, retries, OOM kill), then
+  reports ``task_done`` with the result object ids, their sizes (the
+  drivers' locality scoring input) and any task errors — the bytes stay
+  here until someone pulls them;
+- caches pushed functions by content digest: a driver ships
+  ``cloudpickle.dumps(fn)`` once per (node, digest) and references the
+  digest thereafter; an unknown digest answers ``need_fn`` so the driver
+  reships bytes (cache eviction / daemon restart recovery);
 - serves chunked ``object_meta``/``object_chunk`` reads from its store
   via the shared HeadClient event machinery.
 
@@ -31,11 +40,50 @@ import json
 import pickle
 import signal
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict
 
+from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.scheduler import TaskSpec
+
+
+def completion_fields(store, return_ids: list, name: str):
+    """(sizes, errs, inline) for one finished task's results — the done
+    payload's directory inputs, shared by the task plane and the actor
+    host: sizes feed locality scoring, ERRORS cross as pickled
+    exceptions (no pullable bytes exist for them), and SMALL RESULTS
+    ride inline (<= inline_object_max_bytes — the reference's
+    small-return-to-owner path)."""
+    sizes: Dict[bytes, int] = {}
+    errs: Dict[bytes, bytes] = {}
+    inline: Dict[bytes, bytes] = {}
+    inline_cap = GlobalConfig.inline_object_max_bytes
+    for oid in return_ids:
+        ob = oid.binary()
+        err = store.peek_error(oid)
+        if err is not None:
+            try:
+                errs[ob] = pickle.dumps(err, protocol=5)
+            except Exception:  # noqa: BLE001 — unpicklable error
+                from ray_tpu.exceptions import RayTaskError
+
+                errs[ob] = pickle.dumps(
+                    RayTaskError(name, repr(err)), protocol=5)
+        else:
+            size = store.size_of(oid)
+            sizes[ob] = size
+            # Resident-only: inlining a SPILLED result would pay a
+            # synchronous disk restore on the (single) reporter thread,
+            # stalling every other completion behind it — spilled bytes
+            # move on the pull path instead.
+            if size <= inline_cap and store.holds_in_memory(oid):
+                try:
+                    inline[ob] = store.get(oid, timeout=5.0).to_bytes()
+                except Exception:  # noqa: BLE001 — racing eviction
+                    pass
+    return sizes, errs, inline
 
 
 def prefetch_serialized(pull_fn: Callable[[bytes], Any], oid_bins: list,
@@ -67,6 +115,11 @@ class NodeDaemon:
         self.worker = global_worker()
         self.head = self.worker.head_client
         self.head.handlers["task_push"] = self._on_task_push
+        # Direct plane: drivers dial this node's request server and push
+        # task batches peer-to-peer (one vectored write per batch); the
+        # head relay above stays as the NAT/dial-failure fallback.
+        self.head._object_server.handlers["task_push"] = \
+            self._on_direct_task_push
         self.head.status_fn = self._status
         # Cluster actor plane: host actors placed here by remote drivers
         # (direct actor_op requests + head-relayed actor_push fallback).
@@ -83,40 +136,98 @@ class NodeDaemon:
             max_workers=8, thread_name_prefix="ray_tpu_node_intake")
         self._pulls = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="ray_tpu_node_pull")
-        self._reporter = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="ray_tpu_node_done")
-        # Pushed-task function cache: a fan-out ships the SAME pickled
-        # function N times; deserialize it once per digest. Byte-capped
-        # LRU (pickle size as the weight proxy) so many distinct
-        # functions with fat closures can't pin unbounded memory.
+        # Wait plane for tasks gated on async-shipped (still-pending)
+        # dependencies: wide enough that waiters rarely queue, bounded
+        # so a flood cannot spawn unbounded threads. Dep-free tasks
+        # (every producer) always flow through _intake, so a consumer
+        # here can never starve the producer it waits for.
+        self._gated = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="ray_tpu_node_gated")
+        # Recently accepted task ids (exactly-once across ambiguous
+        # push-retry windows).
+        from collections import deque as _deque
+
+        self._seen_tasks: set = set()
+        self._seen_order: "_deque" = _deque()
+        self._seen_lock = threading.Lock()
+        # Completion reports coalesce: one reporter thread drains every
+        # finish that accumulated while the previous flush was on the
+        # wire into ONE announce flight + ONE vectored task_done batch
+        # per driver (flush-on-idle — same shape as the push plane).
+        from collections import deque
+
+        self._stop = threading.Event()
+        self._report_q: "deque" = deque()
+        self._report_cv = threading.Condition()
+        self._reporter = threading.Thread(
+            target=self._report_loop, daemon=True,
+            name="ray_tpu_node_done")
+        self._reporter.start()
+        # Pushed-function cache, keyed by content digest: a fan-out ships
+        # the SAME function bytes ONCE per node; every later payload
+        # carries only the digest. Byte-capped LRU (pickle size as the
+        # weight proxy) so many distinct functions with fat closures
+        # can't pin unbounded memory; an evicted digest answers
+        # ``need_fn`` and the driver reships.
         from collections import OrderedDict
 
         self._fn_cache: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._fn_cache_bytes = 0
         self._fn_cache_cap = 64 << 20
         self._fn_lock = threading.Lock()
-        self._stop = threading.Event()
+        self.fn_bytes_received = 0  # bench counter: cache effectiveness
 
-    def _load_fn(self, fn_bytes: bytes):
+    # -------------------------------------------------------- function cache
+    def _register_fn(self, fn_bytes: bytes) -> bytes:
+        """Digest + cache one pushed function's bytes (deserialization is
+        deferred to first use). Returns the digest."""
         import hashlib
-
-        import cloudpickle
 
         key = hashlib.sha256(fn_bytes).digest()
         with self._fn_lock:
             hit = self._fn_cache.get(key)
             if hit is not None:
                 self._fn_cache.move_to_end(key)
-                return hit[0]
-        fn = cloudpickle.loads(fn_bytes)
-        with self._fn_lock:
-            if key not in self._fn_cache:
-                self._fn_cache[key] = (fn, len(fn_bytes))
-                self._fn_cache_bytes += len(fn_bytes)
+                return key
+            self.fn_bytes_received += len(fn_bytes)
+            self._fn_cache[key] = (None, bytes(fn_bytes))
+            self._fn_cache_bytes += len(fn_bytes)
             while self._fn_cache_bytes > self._fn_cache_cap \
                     and len(self._fn_cache) > 1:
-                _, (_, nbytes) = self._fn_cache.popitem(last=False)
-                self._fn_cache_bytes -= nbytes
+                _, (_, stale) = self._fn_cache.popitem(last=False)
+                self._fn_cache_bytes -= len(stale)
+        return key
+
+    def _fn_bytes_for(self, digest: bytes):
+        with self._fn_lock:
+            hit = self._fn_cache.get(bytes(digest))
+            return hit[1] if hit is not None else None
+
+    def _load_fn(self, digest: bytes, fallback_bytes=None):
+        """Function for a digest: cache first, else the bytes pinned to
+        the task at accept time (an eviction between accept and start
+        must not fail a task the node already said 'accepted' to)."""
+        import cloudpickle
+
+        key = bytes(digest)
+        with self._fn_lock:
+            hit = self._fn_cache.get(key)
+            if hit is not None:
+                self._fn_cache.move_to_end(key)
+                fn, fn_bytes = hit
+                if fn is not None:
+                    return fn
+            elif fallback_bytes is None:
+                raise KeyError(
+                    f"function digest {key.hex()[:16]}… is not cached on "
+                    f"this node (evicted between accept and start) and "
+                    f"the task carried no pinned bytes")
+            else:
+                fn_bytes = fallback_bytes
+        fn = cloudpickle.loads(fn_bytes)
+        with self._fn_lock:
+            if key in self._fn_cache:
+                self._fn_cache[key] = (fn, fn_bytes)
         return fn
 
     def _status(self) -> dict:
@@ -132,23 +243,133 @@ class NodeDaemon:
 
     # ----------------------------------------------------------- task serve
     def _on_task_push(self, event: tuple):
-        payload = pickle.loads(event[1])
-        self._intake.submit(self._start_task, payload)
+        return self._accept_payload(event[1])
+
+    def _on_direct_task_push(self, msg: tuple):
+        return self._accept_payload(msg[1])
+
+    def _accept_payload(self, payload_bytes):
+        """Admission for one pushed task (either plane). The function
+        cache is settled synchronously HERE — before the ``accepted``
+        reply — so a driver that marks a digest as shipped can never
+        race a not-yet-registered cache entry."""
+        payload = pickle.loads(bytes(payload_bytes))
+        fn_bytes = payload.get("fn")
+        digest = payload.get("fn_digest")
+        if fn_bytes:
+            digest = payload["fn_digest"] = self._register_fn(fn_bytes)
+            payload["fn"] = None  # cached; drop the heavy reference
+        else:
+            # ONE locked lookup settles presence AND pins the bytes to
+            # this task — a concurrent eviction between a separate
+            # membership check and the pin would fail a task the node
+            # already answered "accepted" for.
+            fn_bytes = self._fn_bytes_for(digest) if digest else None
+            if fn_bytes is None:
+                return "need_fn"  # evicted/restarted: driver reships
+        # Pinned: an LRU eviction between accept and start cannot fail
+        # the task (the bytes ride the queued payload).
+        payload["_fn_bytes"] = fn_bytes
+        # Exactly-once across the ambiguous-failure window: a direct
+        # push whose connection died after the send may be resent
+        # verbatim via the head relay — the task already runs here, so
+        # a repeated (task_id, push_id) is acknowledged without
+        # re-submitting (side effects must not double). Deliberate
+        # re-pushes (lineage re-execution, need_fn reships) carry a
+        # FRESH push_id and are admitted; need_fn refusals never enter
+        # this set.
+        key = bytes(payload["task_id"]) + bytes(
+            payload.get("push_id") or b"")
+        with self._seen_lock:
+            if key in self._seen_tasks:
+                return "accepted"
+            self._seen_tasks.add(key)
+            self._seen_order.append(key)
+            while len(self._seen_order) > 65536:
+                self._seen_tasks.discard(self._seen_order.popleft())
+        # Tasks whose PENDING pull-refs (producer still in flight when
+        # the driver shipped them) are not yet local may WAIT here up to
+        # the dep-wait bound — they run on a separate bounded wait plane
+        # so gated waiters can never clog the intake/pull pools or
+        # deadlock a producer queued behind its consumers (producers
+        # with no pending deps always flow through _intake).
+        pending = any(
+            not self.worker.store.is_ready(ObjectID(bytes(ob)))
+            for ob in payload.get("pending_refs") or ())
+        if pending:
+            payload["_gated"] = True
+            self._gated.submit(self._start_task, payload)
+        else:
+            self._intake.submit(self._start_task, payload)
         return "accepted"
 
-    def _ensure_object(self, oid_bin: bytes):
-        """Materialize one pull-ref's bytes into the local store."""
+    def _ensure_object(self, oid_bin: bytes,
+                       deadline: float | None = None):
+        """Materialize one pull-ref's bytes into the local store,
+        WAITING OUT a pending producer: tasks ship with pull-refs before
+        their upstream finished (async dependency shipping), so "no live
+        owner yet" means not-produced-yet, not lost — poll the directory
+        with backoff until the owner announces or the dep-wait bound
+        expires. A producer that FAILED surfaces as the relayed pull
+        raising its task error; materialize it locally so execution
+        reports the real error instead of a timeout."""
         from ray_tpu._private.serialization import SerializedObject
+        from ray_tpu.exceptions import GetTimeoutError, RayTaskError
 
         oid = ObjectID(bytes(oid_bin))
-        if not self.worker.store.is_ready(oid):
-            raw = self.head.object_pull(oid.binary())
-            if raw is None:
-                raise ValueError(
-                    f"pull-ref {oid.hex()[:16]}… has no live owner")
-            self.worker.store.put(oid, SerializedObject.from_bytes(raw))
+        store = self.worker.store
+        if store.is_ready(oid):
+            return
+        if deadline is None:
+            deadline = time.monotonic() + GlobalConfig.dep_wait_s
+        # Event-driven local edge: when the producer runs ON THIS NODE
+        # (locality placement colocates chains), the store's ready
+        # callback wakes the wait the moment the value lands — the
+        # directory backoff below only paces CROSS-node waits.
+        local_ready = threading.Event()
+        store.on_ready(oid, local_ready.set)
+        backoff = 0.02
+        while True:
+            if store.is_ready(oid):
+                return  # local producer / concurrent pull landed it
+            if store.has_local_producer(oid):
+                # The producer runs ON THIS NODE (locality colocation):
+                # the on_ready event is the completion signal — don't
+                # put the head back in the steady-state path with
+                # directory polls that can never resolve sooner.
+                if time.monotonic() > deadline:
+                    raise GetTimeoutError(
+                        f"pull-ref {oid.hex()[:16]}… was not produced "
+                        f"within the dependency wait bound "
+                        f"({GlobalConfig.dep_wait_s:.0f}s, "
+                        f"RAY_TPU_DEP_WAIT_S)")
+                local_ready.wait(backoff)
+                backoff = min(backoff * 2, 0.25)
+                continue
+            raw = None
+            try:
+                raw = self.head.object_pull(oid.binary())
+            except RayTaskError as exc:
+                store.put_error(oid, exc)
+                return
+            except Exception:  # noqa: BLE001 — head hiccup: retry below
+                raw = None
+            if raw is not None:
+                store.put(oid, SerializedObject.from_bytes(raw))
+                return
+            if store.is_ready(oid):
+                return
+            if time.monotonic() > deadline:
+                raise GetTimeoutError(
+                    f"pull-ref {oid.hex()[:16]}… was not produced within "
+                    f"the dependency wait bound "
+                    f"({GlobalConfig.dep_wait_s:.0f}s, RAY_TPU_DEP_WAIT_S)")
+            if self._stop.is_set():
+                raise GetTimeoutError("node daemon shutting down")
+            local_ready.wait(backoff)
+            backoff = min(backoff * 2, 0.25)
 
-    def _unwire_arg(self, wired: tuple) -> Any:
+    def _unwire_arg(self, wired: tuple, deadline: float | None = None):
         from ray_tpu._private.serialization import SerializedObject
 
         kind, data = wired
@@ -157,28 +378,44 @@ class NodeDaemon:
                 SerializedObject.from_bytes(data))
         # Pull-ref: prefetched into the store by _start_task.
         oid = ObjectID(bytes(data))
-        self._ensure_object(oid.binary())  # no-op when prefetch landed it
+        self._ensure_object(oid.binary(), deadline)  # no-op when prefetched
         serialized = self.worker.store.get(oid)
         return self.worker.serialization_context.deserialize(serialized)
 
     def _start_task(self, payload: dict):
-        """Unpack a pushed task, prefetch its remote args in parallel,
-        submit to the local scheduler, and report completion from the
-        store's ready callbacks — no blocking wait, no per-task thread
-        (event-driven dispatch end to end)."""
+        """Unpack a pushed task, prefetch its remote args in parallel
+        (waiting out pending producers — the execution gate for async-
+        shipped dependencies), submit to the local scheduler, and report
+        completion from the store's ready callbacks — no blocking wait,
+        no per-task thread (event-driven dispatch end to end)."""
         return_ids = [ObjectID(bytes(b)) for b in payload["return_ids"]]
+        # This node will produce these objects: gated waiters for them
+        # (colocated consumers) ride the store's ready event instead of
+        # polling the head's directory.
+        for oid in return_ids:
+            self.worker.store.mark_local_producer(oid)
         try:
-            fn = self._load_fn(payload["fn"])
+            fn = self._load_fn(payload["fn_digest"],
+                               payload.get("_fn_bytes"))
+            deadline = time.monotonic() + GlobalConfig.dep_wait_s
             wired = list(payload["args"]) + list(payload["kwargs"].values())
             pull_bins = [bytes(d) for k, d in wired if k == "r"]
-            if pull_bins:
+            if payload.get("_gated"):
+                # Pending producers: this task runs on its OWN thread, so
+                # wait-out pulls happen inline — the shared pull pool
+                # stays free for immediately-resolvable transfers.
+                for ob in pull_bins:
+                    self._ensure_object(ob, deadline)
+            elif pull_bins:
                 prefetched = prefetch_serialized(
-                    self._ensure_object, pull_bins, self._pulls)
+                    lambda ob: self._ensure_object(ob, deadline),
+                    pull_bins, self._pulls)
                 for exc in prefetched.values():
                     if isinstance(exc, BaseException):
                         raise exc
-            args = tuple(self._unwire_arg(a) for a in payload["args"])
-            kwargs = {k: self._unwire_arg(v)
+            args = tuple(self._unwire_arg(a, deadline)
+                         for a in payload["args"])
+            kwargs = {k: self._unwire_arg(v, deadline)
                       for k, v in payload["kwargs"].items()}
             spec = TaskSpec(
                 task_id=TaskID(bytes(payload["task_id"])),
@@ -211,23 +448,90 @@ class NodeDaemon:
                 remaining[0] -= 1
                 if remaining[0] != 0:
                     return
-            self._reporter.submit(self._report_done, payload, return_ids)
+            with self._report_cv:
+                self._report_q.append((payload, return_ids))
+                self._report_cv.notify()
 
         for oid in return_ids:
             self.worker.store.on_ready(oid, _one_ready)
 
-    def _report_done(self, payload: dict, return_ids: list):
+    def _build_done(self, payload: dict, return_ids: list):
+        """(done_bytes, oid_bins, driver_addr, driver_id) for one
+        finished task (completion_fields carries the shared
+        sizes/errs/inline semantics). ERRORED oids are announced too:
+        a remote consumer's pull then RAISES the typed task error (the
+        owner's store serves errors by raising; wire_to_exc keeps the
+        type) instead of spinning against a location-less directory
+        until the dep-wait bound."""
+        sizes, errs, inline = completion_fields(
+            self.worker.store, return_ids, payload.get("name", "task"))
+        oid_bins = [o.binary() for o in return_ids]
         done = pickle.dumps({
             "task_id": bytes(payload["task_id"]),
-            "oid_bins": [o.binary() for o in return_ids],
+            "oid_bins": oid_bins,
             "node_client": self.head.client_id,
+            "sizes": sizes,
+            "errs": errs,
+            "inline": inline,
         }, protocol=5)
-        try:
-            self.head.task_done(
-                payload["driver_id"], [o.binary() for o in return_ids],
-                done)
-        except Exception:  # noqa: BLE001 — driver gone: results stay local
-            pass
+        addr = payload.get("driver_addr")
+        return (done, oid_bins, tuple(addr) if addr else None,
+                payload["driver_id"])
+
+    def _report_loop(self):
+        """Drain finished tasks into batched completion reports: ONE
+        coalesced object_announce flight for every result the batch
+        produced (the head's directory still resolves cross-node pulls
+        and head-restart recovery), then ONE vectored task_done batch
+        pushed DIRECT to each driver's object server — the head is out
+        of the steady-state completion path. Head-relayed task_done
+        stays the per-driver fallback (NAT'd drivers, dial failure)."""
+        from ray_tpu._private.object_server import PeerUnreachableError
+
+        while True:
+            with self._report_cv:
+                while not self._report_q and not self._stop.is_set():
+                    self._report_cv.wait()
+                if self._stop.is_set() and not self._report_q:
+                    return
+                items = list(self._report_q)
+                self._report_q.clear()
+            built = []
+            for payload, return_ids in items:
+                try:
+                    built.append(self._build_done(payload, return_ids))
+                except Exception:  # noqa: BLE001 — keep reporting others
+                    pass
+            announce = [ob for _, oid_bins, _, _ in built
+                        for ob in oid_bins]
+            announced = True
+            try:
+                self.head.object_announce_many(announce)
+            except Exception:  # noqa: BLE001 — head hiccup: take the
+                announced = False  # relay, which re-records locations
+            by_driver: Dict[tuple, list] = {}
+            for done, ok_oids, addr, driver_id in built:
+                by_driver.setdefault((addr, driver_id), []).append(
+                    (done, ok_oids))
+            for (addr, driver_id), entries in by_driver.items():
+                # Direct completion is only legal once the directory
+                # knows the result locations — otherwise the head-relayed
+                # task_done must carry them (it records owners
+                # server-side), or later cross-node pulls find nothing.
+                if addr is not None and announced:
+                    try:
+                        self.head._peers.call_many(
+                            addr, [("task_done", d) for d, _ in entries])
+                        continue
+                    except PeerUnreachableError:
+                        pass  # driver not directly dialable: relay below
+                try:
+                    # One coalesced flight for the whole batch — the
+                    # relay fallback must not serialize N round trips.
+                    self.head.task_done_many(
+                        driver_id, [(ok, d) for d, ok in entries])
+                except Exception:  # noqa: BLE001 — driver gone:
+                    pass           # results stay local
 
     # -------------------------------------------------------------- lifecycle
     def run_forever(self):
@@ -243,7 +547,9 @@ class NodeDaemon:
         import ray_tpu
 
         self._stop.set()
-        for pool in (self._intake, self._pulls, self._reporter):
+        with self._report_cv:
+            self._report_cv.notify_all()
+        for pool in (self._intake, self._pulls, self._gated):
             pool.shutdown(wait=False, cancel_futures=True)
         self.actor_host.shutdown()
         ray_tpu.shutdown()

@@ -236,11 +236,17 @@ class PeerPool:
             raise ConnectionError("object re-announced mid-pull")
         return data
 
-    def call(self, addr: Tuple[str, int], msg: tuple):
-        """Direct request/response against a peer's registered handler.
-        Raises on transport failure (caller falls back to the head relay)
-        or re-raises the handler's wire error."""
-        status = value = None
+    def call_many(self, addr: Tuple[str, int], msgs: list) -> list:
+        """Batched request/response against a peer's registered handlers:
+        all N requests go out in one vectored ``send_many`` write, then
+        the N replies are read back in order (the peer serves a
+        connection serially, so ordering holds). Transport failure
+        anywhere raises ``PeerUnreachableError`` — the whole batch is
+        void and the caller falls back to the head relay. Per-message
+        handler errors come back as exception OBJECTS in the result
+        list, so one bad payload cannot void its batch-mates."""
+        if not msgs:
+            return []
         for attempt in range(2):  # one fresh-lane retry after a dead pick
             lane = None
             try:
@@ -252,20 +258,35 @@ class PeerPool:
                             continue
                         raise ConnectionError("peer lanes are poisoned")
                     try:
-                        lane.conn.send(msg)
-                        status, value = lane.conn.recv()
-                        break
+                        lane.conn.send_many(list(msgs))
+                        replies = [lane.conn.recv() for _ in msgs]
                     except Exception:
                         lane.dead = True  # set UNDER the lock
                         raise
+                out = []
+                for status, value in replies:
+                    if status == "err":
+                        out.append(wire_to_exc(value)
+                                   if isinstance(value, dict)
+                                   else RuntimeError(str(value)))
+                    else:
+                        out.append(value)
+                return out
             except Exception as exc:
                 self._drop(addr, lane)
                 raise PeerUnreachableError(
                     f"peer {addr[0]}:{addr[1]} unreachable: {exc}") from exc
-        if status == "err":
-            raise wire_to_exc(value) if isinstance(value, dict) else \
-                RuntimeError(str(value))
-        return value
+        raise PeerUnreachableError(f"peer {addr[0]}:{addr[1]} unreachable")
+
+    def call(self, addr: Tuple[str, int], msg: tuple):
+        """Direct request/response against a peer's registered handler.
+        Raises on transport failure (caller falls back to the head relay)
+        or re-raises the handler's wire error. One-message case of
+        ``call_many`` — the lane-retry protocol lives there once."""
+        out = self.call_many(addr, [msg])[0]
+        if isinstance(out, BaseException):
+            raise out
+        return out
 
     def close(self):
         with self._lock:

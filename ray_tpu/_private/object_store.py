@@ -124,6 +124,20 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e is not None and e.ready
 
+    def size_of(self, object_id: ObjectID) -> int:
+        """Serialized size of a ready value (0 for errors/unknown) —
+        feeds the object directory's locality scoring."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e.size if e is not None and e.ready else 0
+
+    def holds_in_memory(self, object_id: ObjectID) -> bool:
+        """Ready with its bytes resident (not spilled, not an error) —
+        the gate for zero-cost reads like completion-report inlining."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e is not None and e.ready and e.serialized is not None
+
     def mark_local_producer(self, object_id: ObjectID):
         """A task/actor submitted in THIS driver will produce the object —
         cross-driver pulls for it are pointless."""

@@ -577,17 +577,29 @@ class ActorHost:
                     break
 
     def _report(self, driver_id: str, task_bin: bytes, return_ids):
-        """Announce finished results and send the tiny completion event;
-        the driver pulls the bytes p2p on demand."""
-        self.worker.store.wait(return_ids, len(return_ids), timeout=None)
+        """Announce finished results and send the completion event. Like
+        the task plane's reports, small results ride INLINE and errors
+        cross as pickled exceptions (no pullable bytes exist for them);
+        big results stay pinned here and the driver pulls p2p on
+        demand."""
+        from ray_tpu._private.node_daemon import completion_fields
+
+        store = self.worker.store
+        store.wait(return_ids, len(return_ids), timeout=None)
+        sizes, errs, inline = completion_fields(
+            store, return_ids, "actor task")
         oid_bins = [o.binary() for o in return_ids]
         try:
-            for ob in oid_bins:
-                self.head.object_announce(ob)
+            # Errored oids announce too: a remote consumer's pull then
+            # raises the typed error instead of retrying to a timeout.
+            self.head.object_announce_many(oid_bins)
             done = pickle.dumps({
                 "task_id": task_bin,
                 "oid_bins": oid_bins,
                 "node_client": self.head.client_id,
+                "sizes": sizes,
+                "errs": errs,
+                "inline": inline,
             }, protocol=5)
             self.head.task_done(driver_id, oid_bins, done)
         except Exception:  # noqa: BLE001 — driver/head gone: results stay

@@ -126,10 +126,24 @@ def wire_to_exc(d: dict) -> BaseException:
     if mod in _EXC_MODULES:
         import importlib
 
+        # Task errors re-raised via as_instanceof_cause carry a DYNAMIC
+        # class name like "RayTaskError(ValueError)" that cannot be
+        # imported; resolve the importable base so they still cross the
+        # wire typed (pull-recovery paths match on RayTaskError).
+        base = name.split("(", 1)[0]
         try:
-            cls = getattr(importlib.import_module(mod), name)
+            cls = getattr(importlib.import_module(mod), base)
             if isinstance(cls, type) and issubclass(cls, BaseException):
-                return cls(msg)
+                try:
+                    return cls(msg)
+                except TypeError:
+                    # Rich constructor (RayTaskError's (function_name,
+                    # traceback_str) shape): rebuild a typed instance
+                    # around the formatted message so cross-wire except
+                    # clauses still match — a pulled error must arrive
+                    # as its own type, not a RuntimeError.
+                    if base == "RayTaskError":
+                        return cls("remote task", msg)
         except Exception:  # noqa: BLE001 — fall through to generic
             pass
     return RuntimeError(f"{name}: {msg}")

@@ -5,6 +5,7 @@ metric regressed more than the threshold (default 20 %).
 
 Usage:
     python scripts/check_bench.py [--threshold 0.2] [--dir .]
+        [--require key1,key2]
 
 Record format (written by PR benches): a JSON object whose "after"
 section holds the measurement for the PR's final state. Throughput
@@ -12,6 +13,13 @@ metrics are any numeric leaf whose key ends in "_per_sec" or equals
 "tasks_per_sec"; latency leaves (ending "_us"/"_s") gate in the other
 direction (higher is worse). With fewer than two records the gate
 passes trivially (nothing to regress against).
+
+REQUIRED metrics (--require, default: the cluster fan-out headline)
+gate harder: each must be PRESENT in the newest record (a skipped
+cluster spin-up cannot silently pass), and is compared against the
+most recent PRIOR record that carries it — so a record from a PR that
+benched a different plane in between cannot mask a cross-node
+regression.
 
 Wired as ``make bench-gate``.
 """
@@ -77,6 +85,44 @@ def _record_order(path: str) -> tuple:
     return (int(m.group(1)) if m else -1, path)
 
 
+DEFAULT_REQUIRED = "cluster_fanout_1k.tasks_per_sec"
+
+
+def check_required(paths: list, curr: dict, threshold: float,
+                   required: list) -> list:
+    """Failures for required metrics: missing from the newest record,
+    or regressed vs the most recent PRIOR record carrying the metric."""
+    failures = []
+    cm = _metrics(curr)
+    for key in required:
+        if key not in cm:
+            failures.append(
+                f"required metric {key!r} missing from the newest record "
+                f"(suite skipped?)")
+            continue
+        for path in reversed(paths[:-1]):
+            with open(path) as f:
+                prior = json.load(f)
+            pm = _metrics(prior)
+            if key not in pm:
+                continue
+            old, new = pm[key], cm[key]
+            if old <= 0:
+                break
+            if _is_throughput(key) and new < old * (1.0 - threshold):
+                failures.append(
+                    f"{key}: {new:.1f} < {old:.1f} "
+                    f"(-{(1 - new / old) * 100:.0f}%, vs "
+                    f"{os.path.basename(path)})")
+            elif _is_latency(key) and new > old * (1.0 + threshold):
+                failures.append(
+                    f"{key}: {new:.1f} > {old:.1f} "
+                    f"(+{(new / old - 1) * 100:.0f}%, vs "
+                    f"{os.path.basename(path)})")
+            break  # only the most recent record carrying the metric
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--threshold", type=float, default=0.2,
@@ -84,6 +130,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))),
         help="directory holding BENCH_pr*.json records")
+    ap.add_argument("--require", default=DEFAULT_REQUIRED,
+                    help="comma-separated metric keys that must be present "
+                         "in the newest record and hold against the last "
+                         "record carrying them")
     args = ap.parse_args(argv)
 
     records = sorted(glob.glob(os.path.join(args.dir, "BENCH_pr*.json")),
@@ -98,6 +148,9 @@ def main(argv=None) -> int:
     with open(curr_path) as f:
         curr = json.load(f)
     regressions = compare(prev, curr, args.threshold)
+    required = [k.strip() for k in (args.require or "").split(",")
+                if k.strip()]
+    regressions += check_required(records, curr, args.threshold, required)
     base = (os.path.basename(prev_path), os.path.basename(curr_path))
     if regressions:
         print(f"bench-gate FAIL ({base[1]} vs {base[0]}, "
@@ -106,7 +159,8 @@ def main(argv=None) -> int:
             print(f"  {r}")
         return 1
     print(f"bench-gate OK: {base[1]} holds within "
-          f"{args.threshold:.0%} of {base[0]}")
+          f"{args.threshold:.0%} of {base[0]} "
+          f"(+{len(required)} required metric(s))")
     return 0
 
 

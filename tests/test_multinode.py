@@ -115,7 +115,18 @@ def test_spill_spreads_across_nodes(two_node_cluster):
 
 def test_chained_remote_tasks_pull_node_to_node(two_node_cluster):
     """Task B on node 2 consumes task A's output produced on node 1: the
-    bytes move node-to-node through the head, not via the driver."""
+    bytes move node-to-node, not via the driver — the driver never pulls
+    A's bytes (they travel as a pull-ref resolved on node 2; only the
+    final result it actually get()s may cross to it)."""
+    w = ray_tpu._private.worker.global_worker()
+    pulled = []
+    orig_pull = w.head_client._peers.pull
+
+    def _spy(addr, oid_bin):
+        pulled.append(bytes(oid_bin))
+        return orig_pull(addr, oid_bin)
+
+    w.head_client._peers.pull = _spy
 
     @ray_tpu.remote(resources={"n1": 0.1})
     def produce():
@@ -125,12 +136,14 @@ def test_chained_remote_tasks_pull_node_to_node(two_node_cluster):
     def consume(xs):
         return sum(xs)
 
-    a = produce.remote()
-    total = ray_tpu.get(consume.remote(a), timeout=60)
+    try:
+        a = produce.remote()
+        total = ray_tpu.get(consume.remote(a), timeout=60)
+    finally:
+        w.head_client._peers.pull = orig_pull
     assert total == sum(range(100))
-    # The driver never pulled A's value locally (it rode node-to-node).
-    w = ray_tpu._private.worker.global_worker()
-    assert not w.store.is_ready(a.object_id)
+    assert a.object_id.binary() not in pulled, \
+        "driver pulled the intermediate's bytes"
 
 
 def test_large_object_chunked_pull(two_node_cluster):
@@ -168,7 +181,10 @@ def test_node_kill_lineage_reexecution(two_node_cluster, tmp_path):
     def tracked():
         with open(marker, "a") as f:
             f.write("run\n")
-        return "alive"
+        # Above the inline cap: the bytes stay on the producing node
+        # (small results would ride task_done to the driver and survive
+        # the kill — this test needs a result that actually dies).
+        return "alive" * 50_000
 
     ref = tracked.remote()
     # Wait until the task has completed ON node2 (task_done seen) without
@@ -189,7 +205,7 @@ def test_node_kill_lineage_reexecution(two_node_cluster, tmp_path):
     cluster["node2"].wait(timeout=5)
 
     # get() must recover: pull fails -> lineage re-execution on node1.
-    assert ray_tpu.get(ref, timeout=60) == "alive"
+    assert ray_tpu.get(ref, timeout=60) == "alive" * 50_000
     with open(marker) as f:
         runs = f.read().count("run")
     assert runs == 2, f"expected re-execution (2 runs), saw {runs}"

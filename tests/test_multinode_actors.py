@@ -116,26 +116,41 @@ def test_actor_spread_lands_on_multiple_nodes(cluster):
 
 def test_actor_method_pull_ref_args(cluster):
     """A ref produced on node 1 feeds an actor on node 2 as a pull-ref:
-    the bytes move node-to-node, never through the driver."""
+    the bytes move node-to-node — the driver never pulls them (the ref
+    arg resolves on the consuming node; only results it get()s may
+    cross to it)."""
+    w = ray_tpu._private.worker.global_worker()
+    pulled = []
+    orig_pull = w.head_client._peers.pull
+
+    def _spy(addr, oid_bin):
+        pulled.append(bytes(oid_bin))
+        return orig_pull(addr, oid_bin)
+
+    w.head_client._peers.pull = _spy
 
     @ray_tpu.remote(resources={"n1": 0.1})
     def produce():
         return list(range(1000))
 
-    ref = produce.remote()
-    a = Counter.options(resources={"n2": 1}).remote()
+    try:
+        ref = produce.remote()
+        a = Counter.options(resources={"n2": 1}).remote()
 
-    # Define a method call that consumes the ref: Counter.add takes k.
-    @ray_tpu.remote(resources={"n2": 0.1})
-    def check(xs):
-        return sum(xs)
+        # Define a method call that consumes the ref: Counter.add takes k.
+        @ray_tpu.remote(resources={"n2": 0.1})
+        def check(xs):
+            return sum(xs)
 
-    assert ray_tpu.get(check.remote(ref), timeout=60) == sum(range(1000))
-    # Ref into an actor method too (value resolves host-side).
-    out = ray_tpu.get(a.add.remote(ray_tpu.put(7)), timeout=60)
-    assert out == 7
-    w = ray_tpu._private.worker.global_worker()
-    assert not w.store.is_ready(ref.object_id)  # driver never pulled it
+        assert ray_tpu.get(check.remote(ref), timeout=60) == \
+            sum(range(1000))
+        # Ref into an actor method too (value resolves host-side).
+        out = ray_tpu.get(a.add.remote(ray_tpu.put(7)), timeout=60)
+        assert out == 7
+    finally:
+        w.head_client._peers.pull = orig_pull
+    assert ref.object_id.binary() not in pulled, \
+        "driver pulled the intermediate's bytes"
 
 
 def test_actor_ordering_and_state(cluster):
