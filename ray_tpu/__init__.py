@@ -19,6 +19,7 @@ collective/, util/.
 from ray_tpu._private.config import GlobalConfig as _config  # noqa: F401
 from ray_tpu._private.worker import (
     ObjectRef,
+    ObjectRefGenerator,
     cancel,
     get,
     init,
@@ -46,6 +47,7 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RemoteFunction",
     "announce_object",
     "cancel",

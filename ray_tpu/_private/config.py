@@ -173,6 +173,11 @@ _D("external_pull_ttl_s", float, 600.0,
    "Bound on post-completion pull retries for remote actor-task results "
    "(mirrors the ActorHost result-pin TTL): past it the object is "
    "declared lost instead of retrying forever.")
+_D("generator_backpressure_items", int, 0,
+   "Consumer-driven backpressure for num_returns='streaming' generator "
+   "tasks: the producer's yield loop pauses while this many committed "
+   "items remain unconsumed, resuming on consumption acks "
+   "(RAY_TPU_GENERATOR_BACKPRESSURE_ITEMS; 0 = unlimited).")
 _D("worker_channel_bytes", int, 1024 * 1024,
    "Request/reply channel buffer size per worker process (4 channels per "
    "worker are resident in the shm store; larger blobs are staged as "

@@ -25,6 +25,11 @@ _ACTOR_ID_SIZE = 12
 _NODE_ID_SIZE = 28
 _JOB_ID_SIZE = 4
 
+# Reserved return index for a streaming generator's END MARKER object
+# (commits the total yield count, or the task error). The highest value
+# the 31-bit non-put index space allows — item indices stay below it.
+STREAM_END_INDEX = 0x7FFF_FFFF
+
 
 class BaseID:
     """Immutable binary identifier."""

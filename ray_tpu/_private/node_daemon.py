@@ -120,6 +120,18 @@ class NodeDaemon:
         # head relay above stays as the NAT/dial-failure fallback.
         self.head._object_server.handlers["task_push"] = \
             self._on_direct_task_push
+        # Streaming-generator control plane: consumption acks resume a
+        # backpressure-paused producer, cancels stop it between yields.
+        # Direct messages from the consuming driver; the pub/sub topic
+        # ``stream|<this client>`` is the head-relayed fallback.
+        self.head._object_server.handlers["stream_ack"] = self._on_stream_ack
+        self.head._object_server.handlers["stream_cancel"] = \
+            self._on_stream_cancel
+        try:
+            self.head.subscribe(f"stream|{self.head.client_id}",
+                                self._on_stream_pub)
+        except Exception:  # noqa: BLE001 — direct plane still works
+            pass
         self.head.status_fn = self._status
         # Cluster actor plane: host actors placed here by remote drivers
         # (direct actor_op requests + head-relayed actor_push fallback).
@@ -150,6 +162,19 @@ class NodeDaemon:
         self._seen_tasks: set = set()
         self._seen_order: "_deque" = _deque()
         self._seen_lock = threading.Lock()
+        # Streaming tasks whose commit listener is already installed
+        # (a replayed push must not double-report items).
+        self._streaming_wired: set = set()
+        # Streaming tasks this node already finished AND cleaned up:
+        # late consumption acks for them must not recreate StreamStates
+        # (bounded like _seen_tasks).
+        self._stream_done: set = set()
+        self._stream_done_order: "_deque" = _deque()
+        # StreamStates created by an ack that arrived BEFORE any push for
+        # the task (the driver's post-accept watermark can race ahead, or
+        # the task rerouted to another node after acks were already sent
+        # here): bounded LRU so misrouted acks can't grow streams forever.
+        self._ack_created_order: "_deque" = _deque()
         # Completion reports coalesce: one reporter thread drains every
         # finish that accumulated while the previous flush was on the
         # wire into ONE announce flight + ONE vectored task_done batch
@@ -229,6 +254,56 @@ class NodeDaemon:
             if key in self._fn_cache:
                 self._fn_cache[key] = (fn, fn_bytes)
         return fn
+
+    # ------------------------------------------------------- streaming ctl
+    def _on_stream_ack(self, msg: tuple):
+        """Consumption watermark from the consuming driver: wakes the
+        producer's paused yield loop (thread plane via the stream cv;
+        process plane via the pump's ack-channel forwarding). The state
+        is CREATED if absent — the post-accept watermark re-send of a
+        replayed task can beat _start_task's stream wiring, and a
+        dropped ack there would park the replay at the backpressure
+        budget forever; _start_task's get_or_create then shares this
+        instance. Only acks for already-finished streams are ignored."""
+        tid = TaskID(bytes(msg[1]))
+        with self._seen_lock:
+            done = tid in self._stream_done
+        if not done:
+            st = self.worker.streams.get(tid)
+            if st is None:
+                st = self.worker.streams.get_or_create(tid)
+                with self._seen_lock:
+                    if tid not in self._streaming_wired:
+                        # No push for this task has landed here (yet, or
+                        # ever — it may have rerouted): keep the orphan
+                        # pool bounded. Eviction re-checks wiredness so a
+                        # stream the push later claims is never dropped.
+                        self._ack_created_order.append(tid)
+                        while len(self._ack_created_order) > 4096:
+                            old = self._ack_created_order.popleft()
+                            if old not in self._streaming_wired:
+                                self.worker.streams.pop(old)
+            st.advance_consumed(int(msg[2]))
+        return None
+
+    def _on_stream_cancel(self, msg: tuple):
+        tid = TaskID(bytes(msg[1]))
+        st = self.worker.streams.get(tid)
+        if st is not None:
+            st.cancel()
+        self.worker.scheduler.cancel(tid)
+        return None
+
+    def _on_stream_pub(self, payload):
+        """Head-relayed fallback for stream control messages."""
+        try:
+            kind = payload[0]
+            if kind == "ack":
+                self._on_stream_ack((kind, payload[1], payload[2]))
+            elif kind == "cancel":
+                self._on_stream_cancel((kind, payload[1]))
+        except Exception:  # noqa: BLE001 — keep the event thread alive
+            pass
 
     def _status(self) -> dict:
         hosted = sum(1 for a in self.worker.actors.values()
@@ -394,6 +469,29 @@ class NodeDaemon:
         # polling the head's directory.
         for oid in return_ids:
             self.worker.store.mark_local_producer(oid)
+        streaming = bool(payload.get("streaming"))
+        if streaming:
+            # Pre-wire the producer-side stream BEFORE execution: every
+            # yield's commit enqueues an item_done report (small items
+            # inline, large items announce + p2p pull — the per-yield
+            # analogue of task_done). A replayed push reuses the existing
+            # state, so the listener installs exactly once per task.
+            tid = TaskID(bytes(payload["task_id"]))
+            with self._seen_lock:
+                fresh = tid not in self._streaming_wired
+                self._streaming_wired.add(tid)
+                # A deliberate re-push (lineage recovery on the same
+                # node) reopens the stream: acks must apply again.
+                self._stream_done.discard(tid)
+            if fresh:
+                stream = self.worker.streams.get_or_create(tid)
+
+                def _on_commit(idx, oid, _payload=payload):
+                    with self._report_cv:
+                        self._report_q.append(("item", _payload, idx, oid))
+                        self._report_cv.notify()
+
+                stream.add_commit_listener(_on_commit)
         try:
             fn = self._load_fn(payload["fn_digest"],
                                payload.get("_fn_bytes"))
@@ -426,7 +524,9 @@ class NodeDaemon:
                 resources=dict(payload["resources"]),
                 max_retries=payload["max_retries"],
                 retry_exceptions=payload["retry_exceptions"],
-                runtime_env=payload.get("runtime_env"))
+                runtime_env=payload.get("runtime_env"),
+                streaming=streaming,
+                backpressure=int(payload.get("backpressure", 0)))
             self.worker.scheduler.submit(spec)
         except BaseException as exc:  # noqa: BLE001 — report, don't die
             from ray_tpu.exceptions import RayTaskError
@@ -449,7 +549,7 @@ class NodeDaemon:
                 if remaining[0] != 0:
                     return
             with self._report_cv:
-                self._report_q.append((payload, return_ids))
+                self._report_q.append(("done", payload, return_ids))
                 self._report_cv.notify()
 
         for oid in return_ids:
@@ -478,6 +578,33 @@ class NodeDaemon:
         return (done, oid_bins, tuple(addr) if addr else None,
                 payload["driver_id"])
 
+    def _build_item(self, payload: dict, idx: int, oid):
+        """One yield's item_done report: inline the bytes when small
+        (<= inline_object_max_bytes), else ship owner + size so the
+        consumer pulls p2p. Returns (item_bytes, announce_oid_or_None,
+        addr, driver_id)."""
+        store = self.worker.store
+        size = store.size_of(oid)
+        inline = None
+        if size <= GlobalConfig.inline_object_max_bytes \
+                and store.holds_in_memory(oid):
+            try:
+                inline = store.get(oid, timeout=5.0).to_bytes()
+            except Exception:  # noqa: BLE001 — racing eviction
+                pass
+        item = pickle.dumps({
+            "task_id": bytes(payload["task_id"]),
+            "idx": int(idx),
+            "oid": oid.binary(),
+            "inline": inline,
+            "size": size,
+            "node_client": self.head.client_id,
+        }, protocol=5)
+        addr = payload.get("driver_addr")
+        announce = oid.binary() if inline is None else None
+        return (item, announce, tuple(addr) if addr else None,
+                payload["driver_id"])
+
     def _report_loop(self):
         """Drain finished tasks into batched completion reports: ONE
         coalesced object_announce flight for every result the batch
@@ -485,7 +612,10 @@ class NodeDaemon:
         and head-restart recovery), then ONE vectored task_done batch
         pushed DIRECT to each driver's object server — the head is out
         of the steady-state completion path. Head-relayed task_done
-        stays the per-driver fallback (NAT'd drivers, dial failure)."""
+        stays the per-driver fallback (NAT'd drivers, dial failure).
+        Streaming item_done reports ride the same batches: many yields
+        that accumulate while one flush is on the wire coalesce into one
+        vectored flight per driver."""
         from ray_tpu._private.object_server import PeerUnreachableError
 
         while True:
@@ -496,23 +626,44 @@ class NodeDaemon:
                     return
                 items = list(self._report_q)
                 self._report_q.clear()
-            built = []
-            for payload, return_ids in items:
+            built = []       # ("task_done"/"item_done", bytes, addr, drv)
+            announce = []
+            for entry in items:
                 try:
-                    built.append(self._build_done(payload, return_ids))
+                    if entry[0] == "item":
+                        _, payload, idx, oid = entry
+                        item, ann, addr, drv = self._build_item(
+                            payload, idx, oid)
+                        if ann is not None:
+                            announce.append(ann)
+                        built.append(("item_done", item, addr, drv))
+                    else:
+                        _, payload, return_ids = entry
+                        done, oid_bins, addr, drv = self._build_done(
+                            payload, return_ids)
+                        announce.extend(oid_bins)
+                        built.append(("task_done", done, addr, drv,
+                                      oid_bins))
+                        if payload.get("streaming"):
+                            tid = TaskID(bytes(payload["task_id"]))
+                            self.worker.streams.pop(tid)
+                            with self._seen_lock:
+                                self._streaming_wired.discard(tid)
+                                self._stream_done.add(tid)
+                                self._stream_done_order.append(tid)
+                                while len(self._stream_done_order) > 65536:
+                                    self._stream_done.discard(
+                                        self._stream_done_order.popleft())
                 except Exception:  # noqa: BLE001 — keep reporting others
                     pass
-            announce = [ob for _, oid_bins, _, _ in built
-                        for ob in oid_bins]
             announced = True
             try:
                 self.head.object_announce_many(announce)
             except Exception:  # noqa: BLE001 — head hiccup: take the
                 announced = False  # relay, which re-records locations
             by_driver: Dict[tuple, list] = {}
-            for done, ok_oids, addr, driver_id in built:
-                by_driver.setdefault((addr, driver_id), []).append(
-                    (done, ok_oids))
+            for rec in built:
+                by_driver.setdefault((rec[2], rec[3]), []).append(rec)
             for (addr, driver_id), entries in by_driver.items():
                 # Direct completion is only legal once the directory
                 # knows the result locations — otherwise the head-relayed
@@ -521,15 +672,23 @@ class NodeDaemon:
                 if addr is not None and announced:
                     try:
                         self.head._peers.call_many(
-                            addr, [("task_done", d) for d, _ in entries])
+                            addr, [(kind, data)
+                                   for kind, data, *_ in entries])
                         continue
                     except PeerUnreachableError:
                         pass  # driver not directly dialable: relay below
+                dones = [(rec[4], rec[1]) for rec in entries
+                         if rec[0] == "task_done"]
                 try:
-                    # One coalesced flight for the whole batch — the
-                    # relay fallback must not serialize N round trips.
-                    self.head.task_done_many(
-                        driver_id, [(ok, d) for d, ok in entries])
+                    if dones:
+                        # One coalesced flight for the whole batch — the
+                        # relay fallback must not serialize N round trips.
+                        self.head.task_done_many(driver_id, dones)
+                    for rec in entries:
+                        if rec[0] == "item_done":
+                            # Per-item relay fallback rides pub/sub.
+                            self.head.publish(f"stream|{driver_id}",
+                                              ("item_done", rec[1]))
                 except Exception:  # noqa: BLE001 — driver gone:
                     pass           # results stay local
 

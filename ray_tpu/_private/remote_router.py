@@ -93,6 +93,11 @@ class RemoteRouter:
         # payload) — the head only sees coalesced object announces.
         self.head._object_server.handlers["task_done"] = \
             self._on_task_done_direct
+        # Streaming generators: per-yield item_done reports arrive on the
+        # same direct plane (small items inline, large items announce +
+        # p2p pull), exactly like task_done; the pub/sub topic
+        # ``stream|<client>`` is the head-relayed fallback.
+        self.head._object_server.handlers["item_done"] = self._on_item_done
         self.lineage: Dict[TaskID, TaskSpec] = {}
         self._done: Dict[TaskID, threading.Event] = {}
         self._done_cbs: Dict[TaskID, List[Callable[[], None]]] = {}
@@ -143,6 +148,14 @@ class RemoteRouter:
             self.head.status_fn = self._status
         self._recovering: set = set()
         self._prefetching: set = set()
+        # Streaming generator bookkeeping: tasks whose consumption acks
+        # this driver must propagate (consume-listener installed once per
+        # task), the coalesced ack watermarks awaiting a wire flush, and
+        # the per-task single-flight sender guard.
+        self._stream_tasks: Set[TaskID] = set()
+        self._stream_ack_pending: Dict[TaskID, int] = {}
+        self._stream_ack_inflight: Set[TaskID] = set()
+        self._stream_sub = False  # lazy fallback-topic subscription
         self._lock = threading.Lock()
         self._nodes_cache: tuple = (0.0, [])
         # Dispatch plane: a single grouping thread drains submitted
@@ -492,6 +505,8 @@ class RemoteRouter:
         as pending pull-refs — async dependency shipping); only deps the
         driver itself must inline (untracked local producers) hold the
         task back, on the blocking-wait pool, event-driven."""
+        if spec.streaming:
+            self._track_stream(spec)
         with self._lock:
             self.lineage[spec.task_id] = spec
             self._done.setdefault(spec.task_id, threading.Event())
@@ -674,6 +689,16 @@ class RemoteRouter:
         for (spec, tried, _), rep in zip(built, replies):
             if rep == "accepted":
                 self._register_pushed(spec.task_id, cid)
+                if spec.streaming:
+                    # Replayed producers start a FRESH StreamState with
+                    # consumed=0 on the new node; without re-sending the
+                    # consumer's watermark the replay parks at the
+                    # backpressure budget before re-reaching the
+                    # consumer's index and the stream deadlocks — acks
+                    # otherwise fire only on NEW consumption.
+                    st = self.worker.streams.get(spec.task_id)
+                    if st is not None and st.consumed > 0:
+                        self._send_stream_ack(spec.task_id, st.consumed)
             elif rep == "need_fn" and reship_ok:
                 # The node lost (or never saw) this digest: rebuild with
                 # the function bytes forced in and push once more.
@@ -842,6 +867,13 @@ class RemoteRouter:
             "args": [_wire_arg(a) for a in spec.args],
             "kwargs": {k: _wire_arg(v) for k, v in spec.kwargs.items()},
         }
+        if spec.streaming:
+            # Streaming generator: the node commits one object per yield
+            # and pushes per-item ``item_done`` reports back over this
+            # same direct plane; the backpressure budget governs its
+            # yield loop, resumed by this driver's consumption acks.
+            payload["streaming"] = True
+            payload["backpressure"] = int(spec.backpressure)
         if pending_refs:
             # The node gates THESE refs on its wait plane; ordinary
             # owner-resolvable pull-refs stay on its bounded pull pools.
@@ -1017,6 +1049,11 @@ class RemoteRouter:
             if cid is not None:
                 self._dec_inflight_locked(cid)
             self._task_target.pop(tid, None)
+            # Stream bookkeeping ends with the task: no more item
+            # reports will need acks, and leaving entries behind grows
+            # the router unboundedly under continuous streaming load.
+            self._stream_tasks.discard(tid)
+            self._stream_ack_pending.pop(tid, None)
             if first_exc is not None:
                 self._failed.setdefault(tid, first_exc)
             children = self._dep_children.pop(tid, set())
@@ -1037,6 +1074,121 @@ class RemoteRouter:
             for ctid in children:
                 self._fail_downstream(ctid, first_exc)
         return None
+
+    # ----------------------------------------------------------- streaming
+    def _track_stream(self, spec: TaskSpec):
+        """First acceptance of a streaming spec: install the consumption
+        listener (acks propagate to whichever node currently runs the
+        producer) and the head-relayed fallback subscription."""
+        with self._lock:
+            if spec.task_id in self._stream_tasks:
+                return  # re-accept (replay): listener already installed
+            self._stream_tasks.add(spec.task_id)
+            need_sub = not self._stream_sub
+            self._stream_sub = True
+        if need_sub:
+            try:
+                self.head.subscribe(f"stream|{self.head.client_id}",
+                                    self._on_stream_pub)
+            except Exception:  # noqa: BLE001 — direct plane still works
+                pass
+        stream = self.worker.streams.get_or_create(spec.task_id)
+        stream.add_consume_listener(
+            lambda n, _tid=spec.task_id: self._send_stream_ack(_tid, n))
+
+    def _on_stream_pub(self, payload):
+        """Head-relayed fallback for per-item reports (NAT'd nodes)."""
+        try:
+            if payload and payload[0] == "item_done":
+                self._on_item_done(("item_done", payload[1]))
+        except Exception:  # noqa: BLE001 — keep the event thread alive
+            pass
+
+    def _on_item_done(self, msg: tuple):
+        """One yield committed on the producing node: small items arrive
+        INLINE (materialize -> the consumer's next() unblocks on the
+        store event); large items record owner + size so next() drives a
+        p2p pull."""
+        from ray_tpu._private.serialization import SerializedObject
+
+        payload = pickle.loads(bytes(msg[1]))
+        tid = TaskID(bytes(payload["task_id"]))
+        stream = self.worker.streams.get(tid)
+        if stream is None:
+            # The consumer already closed/released this stream: a late
+            # report must not resurrect a StreamState nothing will pop,
+            # nor pin item bytes the generator's one-shot free covered.
+            return None
+        oid = ObjectID(bytes(payload["oid"]))
+        raw = payload.get("inline")
+        if raw is not None:
+            self.worker.store.put(oid, SerializedObject.from_bytes(raw))
+        else:
+            size = int(payload.get("size", 0))
+            with self._lock:
+                self._oid_owner[oid.binary()] = payload["node_client"]
+                self._oid_sizes[oid.binary()] = size
+            stream.known_remote_sizes[int(payload["idx"])] = size
+        stream.commit(int(payload["idx"]))
+        return None
+
+    def _stream_node(self, tid: TaskID):
+        """(addr, client_id) of the node currently running a stream's
+        producer, or (None, None)."""
+        with self._lock:
+            cid = self._task_node.get(tid) or self._task_target.get(tid)
+            node = self._node_rec.get(cid) if cid else None
+        if node is None and cid is not None:
+            node = next((n for n in self.nodes()
+                         if n["client_id"] == cid), None)
+        return (self._node_addr(node) if node else None), cid
+
+    def _send_stream_ack(self, tid: TaskID, n: int):
+        """Coalesced, single-flight-per-task ack sender: only the LATEST
+        consumption watermark matters, so a fast consumer costs one wire
+        message per flush, not one per item."""
+        with self._lock:
+            cur = self._stream_ack_pending.get(tid, 0)
+            self._stream_ack_pending[tid] = max(cur, n)
+            if tid in self._stream_ack_inflight:
+                return
+            self._stream_ack_inflight.add(tid)
+        self._prefetch_pool.submit(self._flush_stream_acks, tid)
+
+    def _flush_stream_acks(self, tid: TaskID):
+        while True:
+            with self._lock:
+                n = self._stream_ack_pending.pop(tid, None)
+                if n is None:
+                    self._stream_ack_inflight.discard(tid)
+                    return
+            self._stream_ctl(tid, ("stream_ack", tid.binary(), int(n)),
+                             ("ack", tid.binary(), int(n)))
+
+    def cancel_stream(self, tid: TaskID):
+        """Generator dropped/closed consumer-side: cancel the in-flight
+        producer task on its node (cooperative — the node's yield loop
+        stops between yields) and release its stream state."""
+        with self._lock:
+            self._stream_tasks.discard(tid)
+            self._stream_ack_pending.pop(tid, None)
+        self._stream_ctl(tid, ("stream_cancel", tid.binary()),
+                         ("cancel", tid.binary()))
+
+    def _stream_ctl(self, tid: TaskID, direct_msg: tuple, pub_msg: tuple):
+        addr, cid = self._stream_node(tid)
+        if cid is None:
+            return
+        if addr is not None:
+            try:
+                self.head._peers.call(addr, direct_msg)
+                return
+            except Exception:  # noqa: BLE001 — fall back to the relay
+                pass
+        try:
+            self.head.publish(f"stream|{cid}", pub_msg)
+        except Exception:  # noqa: BLE001 — producer stays paused until
+            pass           # the next watermark flush retries
 
     def handles(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -1225,15 +1377,19 @@ class RemoteRouter:
                         self._dec_inflight_locked(client_id)
                 if spec is None or not still_there:
                     continue
-                retry = TaskSpec(
-                    task_id=spec.task_id, function=spec.function,
-                    args=spec.args, kwargs=spec.kwargs,
-                    num_returns=spec.num_returns,
-                    return_ids=spec.return_ids, name=spec.name,
-                    resources=spec.resources, max_retries=spec.max_retries,
-                    retry_exceptions=spec.retry_exceptions,
-                    scheduling_strategy=spec.scheduling_strategy,
-                    attempt=spec.attempt + 1)
+                if spec.attempt >= spec.max_retries:
+                    # Retries exhausted (max_retries=0 tasks never
+                    # replay): materialize the typed error — for a
+                    # streaming task it lands on the end marker, so the
+                    # consumer's next() raises instead of hanging.
+                    self._fail(spec, WorkerCrashedError(
+                        f"task {spec.name!r} was in flight on a node "
+                        f"that died and max_retries={spec.max_retries} "
+                        f"is exhausted"))
+                    continue
+                import dataclasses
+
+                retry = dataclasses.replace(spec, attempt=spec.attempt + 1)
                 self._accept(retry, None, tried=(client_id,))
 
     def shutdown(self):

@@ -45,6 +45,11 @@ class TaskSpec:
     retry_exceptions: bool = False
     scheduling_strategy: Any = None
     runtime_env: Any = None
+    # Streaming generator (num_returns="streaming"): return_ids holds only
+    # the END MARKER; item objects commit dynamically per yield, with the
+    # producer pausing at `backpressure` committed-but-unconsumed items.
+    streaming: bool = False
+    backpressure: int = 0
     # Filled by the scheduler:
     attempt: int = 0
 
@@ -438,8 +443,12 @@ class LocalScheduler:
                 try:
                     args, kwargs = self._resolve_args_proc(
                         spec.args, spec.kwargs, pinned)
-                    self._execute_in_process(spec, args, kwargs,
-                                             cancelled_event)
+                    if spec.streaming:
+                        self._execute_in_process_stream(
+                            spec, args, kwargs, cancelled_event)
+                    else:
+                        self._execute_in_process(spec, args, kwargs,
+                                                 cancelled_event)
                 finally:
                     self._unpin_shm_keys(pinned)
             else:
@@ -456,15 +465,26 @@ class LocalScheduler:
                         raise RuntimeEnvSetupError(
                             "pip/uv runtime envs need process workers "
                             "(worker_mode='process', the default)")
+
+                    def _invoke():
+                        result = spec.function(*args, **kwargs)
+                        if spec.streaming:
+                            # Yield loop runs inside the env context so
+                            # the generator BODY sees the runtime env.
+                            self._stream_outputs(spec, result,
+                                                 cancelled_event)
+                        return result
+
                     if renv is not None:
                         with renv.stage().applied():
-                            result = spec.function(*args, **kwargs)
+                            result = _invoke()
                     else:
-                        result = spec.function(*args, **kwargs)
+                        result = _invoke()
                 finally:
                     worker_mod._task_context.current_task_id = None
                     worker_mod._task_context.task_name = None
-                self._store_outputs(spec, result)
+                if not spec.streaming:
+                    self._store_outputs(spec, result)
             if self._events:
                 self._events.record(
                     spec.task_id, "FINISHED", name=spec.name,
@@ -726,6 +746,98 @@ class LocalScheduler:
             except Exception:  # noqa: BLE001 — best-effort cleanup
                 pass
 
+    # ------------------------------------------------------------- streaming
+    def _stream_outputs(self, spec: TaskSpec, result: Any, cancelled_event):
+        """Thread-plane yield loop: each yield commits one dynamically
+        created return object IMMEDIATELY (the consumer's next() unblocks
+        on it), then the producer pauses while committed-but-unconsumed
+        items have reached the backpressure budget. Cancellation (dropped
+        generator / explicit cancel) stops the loop cooperatively between
+        yields. Lineage re-execution replays from yield 0; already-
+        committed indices re-put idempotently, so consumed items keep
+        their first-attempt values (dedup by construction)."""
+        from ray_tpu._private.streaming import stream_end_id, stream_item_id
+        from ray_tpu._private.worker import global_worker
+
+        if not hasattr(result, "__iter__") and \
+                not hasattr(result, "__next__"):
+            raise TypeError(
+                f"task {spec.name!r} declared num_returns='streaming' but "
+                f"returned non-iterable {type(result).__name__}")
+        worker = global_worker()
+        ctx = worker.serialization_context
+        stream = worker.streams.get_or_create(spec.task_id)
+        it = iter(result)
+        idx = 0
+        try:
+            for item in it:
+                if cancelled_event.is_set() or stream.cancelled:
+                    raise TaskCancelledError(spec.task_id)
+                self._store.put(stream_item_id(spec.task_id, idx),
+                                ctx.serialize(item))
+                stream.commit(idx)
+                idx += 1
+                if not stream.wait_capacity(spec.backpressure,
+                                            cancelled_event):
+                    raise TaskCancelledError(spec.task_id)
+        except BaseException:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — generator cleanup
+                    pass
+            raise
+        self._store.put(stream_end_id(spec.task_id), ctx.serialize(idx))
+        stream.finish(idx)
+
+    def _execute_in_process_stream(self, spec: TaskSpec, args, kwargs,
+                                   cancelled_event):
+        """Process-plane streaming: ship a ``task_stream`` request to a
+        leased worker, then pump its reply channel — each ``item`` frame
+        commits one return object into the driver store as the worker
+        yields; consumption acks travel back on the worker's stream-ack
+        channel (the pause protocol lives in worker_main). A kill -9 of
+        the worker mid-stream surfaces WorkerCrashedError (retriable:
+        lineage replay re-runs the generator from yield 0)."""
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu._private.worker_pool import (
+            maybe_stage,
+            pack_args,
+            pack_function,
+        )
+
+        ctx = global_worker().serialization_context
+        stream = global_worker().streams.get_or_create(spec.task_id)
+        w = self._worker_pool.lease(runtime_env=spec.runtime_env)
+        staged: list = []
+        try:
+            digest, fn_bytes = pack_function(spec.function)
+            payload, staged = pack_args(self._shm_store, ctx, args, kwargs)
+            limit = max(w.max_msg // 4, 64 * 1024)
+            fn_bytes, st = maybe_stage(self._shm_store, fn_bytes, limit)
+            staged += st
+            payload, st = maybe_stage(self._shm_store, payload, limit)
+            staged += st
+            env_fields = (dict(spec.runtime_env)
+                          if spec.runtime_env is not None else None)
+            with self._lock:
+                self._proc_running[spec.task_id] = w
+            try:
+                w._req.write(
+                    ("task_stream", digest, fn_bytes, payload,
+                     spec.task_id.binary(), spec.name, env_fields,
+                     int(spec.backpressure)), timeout=60.0)
+                pump_stream_replies(
+                    w, spec.task_id, spec.name, stream, self._store,
+                    self._shm_store, ctx, cancelled_event)
+            finally:
+                with self._lock:
+                    self._proc_running.pop(spec.task_id, None)
+        finally:
+            self._delete_shm_keys(staged)
+            self._worker_pool.release(w)
+
     def _store_outputs(self, spec: TaskSpec, result: Any):
         from ray_tpu._private.worker import global_worker
 
@@ -766,16 +878,9 @@ class LocalScheduler:
         if self._events:
             self._events.record(spec.task_id, "FAILED", name=spec.name)
         if retriable and not cancelled:
-            return TaskSpec(
-                task_id=spec.task_id, function=spec.function, args=spec.args,
-                kwargs=spec.kwargs, num_returns=spec.num_returns,
-                return_ids=spec.return_ids, name=spec.name,
-                resources=spec.resources, max_retries=spec.max_retries,
-                retry_exceptions=spec.retry_exceptions,
-                scheduling_strategy=spec.scheduling_strategy,
-                runtime_env=spec.runtime_env,
-                attempt=spec.attempt + 1,
-            )
+            import dataclasses
+
+            return dataclasses.replace(spec, attempt=spec.attempt + 1)
         if isinstance(exc, (TaskCancelledError, RayTaskError,
                             OutOfMemoryError)):
             error = exc  # typed system/dependency failures stay unwrapped
@@ -783,6 +888,20 @@ class LocalScheduler:
             error = RayTaskError.from_exception(spec.name, exc)
         for oid in spec.return_ids:
             self._store.put_error(oid, error)
+        if spec.streaming:
+            self._fail_stream(spec, error)
+
+    def _fail_stream(self, spec: TaskSpec, error: BaseException):
+        """Terminal streaming failure: record it on the stream state so a
+        paused producer/consumer wakes, and release the replay barrier."""
+        from ray_tpu._private.worker import _try_global_worker
+
+        w = _try_global_worker()
+        if w is None:
+            return
+        stream = w.streams.get(spec.task_id)
+        if stream is not None:
+            stream.set_error(error)
 
     def _finish_cancelled(self, spec: TaskSpec):
         err = TaskCancelledError(spec.task_id)
@@ -870,6 +989,121 @@ class LocalScheduler:
             self._dq.wake()
             self._dq_pump.join(timeout=2)
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def pump_stream_replies(w, task_id, name: str, stream, store, shm_store,
+                        ctx, cancelled_event=None):
+    """Driver-side pump for one process-plane stream (shared by the task
+    scheduler and sync process actors): read ``item`` frames off the
+    worker's reply channel into the local store, forward consumption acks
+    on the stream-ack channel (coalesced — only the latest watermark
+    matters), and translate worker death into WorkerCrashedError. Returns
+    the total item count on clean completion."""
+    import pickle as _pickle
+
+    from ray_tpu._private.serialization import SerializedObject
+    from ray_tpu._private.streaming import stream_end_id, stream_item_id
+    from ray_tpu.exceptions import (
+        ChannelTimeoutError,
+        WorkerCrashedError,
+    )
+
+    tid_bin = task_id.binary()
+    last_acked = [0]
+    done = threading.Event()
+
+    def _send_ack(n: int) -> bool:
+        if done.is_set():
+            return False
+        try:
+            w._ack.write(("stream_ack", tid_bin, n), timeout=0.05)
+            if n > last_acked[0]:
+                last_acked[0] = n
+            return True
+        except Exception:  # noqa: BLE001 — pump retries with the latest
+            return False
+
+    # Immediate ack from the consumer thread keeps resume latency off the
+    # pump's read-slice cadence; the pump loop below is the retry path.
+    stream.add_consume_listener(_send_ack)
+    cancel_sent = [False]
+
+    def _drain_after_error():
+        """Driver-side failure while the worker is alive and mid-stream
+        (e.g. a staged item key evicted, the local store put failing):
+        the reply channel still carries item/terminal frames, and
+        releasing the worker now would desync the next lease's reply
+        protocol. Cancel cooperatively and drain to the terminal frame;
+        a worker that will not settle is condemned so the pool replaces
+        it instead of reusing a dirty channel."""
+        _send_ack(-1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                m = w._rep.read(timeout=0.25)
+            except ChannelTimeoutError:
+                if w.proc.poll() is not None:
+                    w._dead = True
+                    return
+                _send_ack(-1)
+                continue
+            except Exception:  # noqa: BLE001 — channel torn down
+                break
+            if m and m[0] in ("ok", "cancelled", "err"):
+                return
+        w._dead = True
+    try:
+        while True:
+            cancelled_now = ((cancelled_event is not None
+                              and cancelled_event.is_set())
+                             or stream.cancelled)
+            if cancelled_now and not cancel_sent[0]:
+                cancel_sent[0] = _send_ack(-1)  # -1 = cooperative cancel
+            if stream.consumed > last_acked[0]:
+                _send_ack(stream.consumed)
+            try:
+                msg = w._rep.read(timeout=0.05)
+            except ChannelTimeoutError:
+                if w.proc.poll() is not None:
+                    w._dead = True
+                    if cancelled_now:
+                        raise TaskCancelledError(task_id)
+                    raise WorkerCrashedError(
+                        f"worker {w.pid} died mid-stream of task "
+                        f"{name!r} (exit code {w.proc.returncode})")
+                continue
+            kind = msg[0]
+            if kind == "item":
+                try:
+                    _, idx, field = msg
+                    if isinstance(field, tuple) and field and \
+                            field[0] == "shm":
+                        raw = bytes(shm_store.get(field[1]))
+                        try:
+                            shm_store.delete(field[1])
+                        except Exception:  # noqa: BLE001
+                            pass
+                    else:
+                        raw = bytes(field)
+                    store.put(stream_item_id(task_id, idx),
+                              SerializedObject.from_bytes(raw))
+                    stream.commit(idx)
+                except BaseException:
+                    _drain_after_error()
+                    raise
+            elif kind == "ok":
+                total = int(msg[1])
+                store.put(stream_end_id(task_id), ctx.serialize(total))
+                stream.finish(total)
+                return total
+            elif kind == "cancelled":
+                raise TaskCancelledError(task_id)
+            elif kind == "err":
+                raise _pickle.loads(msg[1])
+            # Anything else (stale frame from a crashed predecessor) is
+            # dropped; the liveness check above bounds the stall.
+    finally:
+        done.set()
 
 
 def _shape_key(resources: Dict[str, float]) -> tuple:

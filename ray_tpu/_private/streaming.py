@@ -1,0 +1,187 @@
+"""Streaming-generator plane: per-task stream state + registry.
+
+Rebuild of the reference's streaming generator machinery (reference:
+``ObjectRefGenerator`` in python/ray/_raylet.pyx plus the core-worker
+task-manager streaming protocol [unverified]). A ``num_returns="streaming"``
+task commits one object per yield — ``ObjectID.for_task_return(task_id, i)``,
+dynamically created return refs derived from the task id exactly like
+static returns, so lineage reconstruction re-derives the same ids and a
+replayed generator re-commits already-consumed indices idempotently.
+
+One ``StreamState`` per task tracks the stream on WHICHEVER runtime hosts
+the role:
+
+- the PRODUCER runtime (driver thread plane, worker process, node daemon)
+  counts committed yields and pauses the yield loop when
+  committed-but-unconsumed items reach the backpressure budget
+  (``RAY_TPU_GENERATOR_BACKPRESSURE_ITEMS``);
+- the CONSUMER runtime (the driver owning the ``ObjectRefGenerator``)
+  counts consumption at ``next()`` and fires ack callbacks that propagate
+  the consumed watermark back to the producer. In-process both roles share
+  ONE instance; across a worker-process boundary acks ride the stream-ack
+  channel; across nodes they ride ``item_ack`` on the direct plane.
+
+End-of-stream is itself an object: the STREAM END MARKER
+(``ObjectID.for_task_return(task_id, STREAM_END_INDEX)``) commits the total
+item count when the generator finishes — or the task's error — so the
+whole existing completion machinery (submitted-ref release, ``task_done``
+reporting, typed error materialization, ``ray_tpu.wait``) applies to
+streaming tasks unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.ids import STREAM_END_INDEX, ObjectID, TaskID
+
+__all__ = ["STREAM_END_INDEX", "StreamState", "StreamRegistry",
+           "stream_item_id", "stream_end_id"]
+
+
+def stream_item_id(task_id: TaskID, index: int) -> ObjectID:
+    """The dynamically-created return ref of one yield."""
+    if index >= STREAM_END_INDEX:
+        raise ValueError(
+            f"streaming generator yielded more than {STREAM_END_INDEX} "
+            f"items (index space exhausted)")
+    return ObjectID.for_task_return(task_id, index)
+
+
+def stream_end_id(task_id: TaskID) -> ObjectID:
+    return ObjectID.for_task_return(task_id, STREAM_END_INDEX)
+
+
+class StreamState:
+    """Producer/consumer bookkeeping for one streaming-generator task."""
+
+    __slots__ = ("task_id", "_cv", "committed", "consumed", "finished",
+                 "error", "cancelled", "peak_unconsumed", "paused_events",
+                 "_commit_cbs", "_consume_cbs", "known_remote_sizes")
+
+    def __init__(self, task_id: TaskID):
+        self.task_id = task_id
+        self._cv = threading.Condition()
+        self.committed = 0          # contiguous commit count (producer side)
+        self.consumed = 0           # consumer watermark (next() returns)
+        self.finished: Optional[int] = None  # total items once producer ends
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+        # Telemetry proved in tests/bench: the max committed-but-unconsumed
+        # count this producer ever reached, and how often it paused.
+        self.peak_unconsumed = 0
+        self.paused_events = 0
+        self._commit_cbs: List[Callable[[int, ObjectID], None]] = []
+        self._consume_cbs: List[Callable[[int], None]] = []
+        # Consumer side: item index -> byte size for items whose bytes
+        # stayed on the producing node (announce + pull, not inlined).
+        self.known_remote_sizes: Dict[int, int] = {}
+
+    # ------------------------------------------------------------- producer
+    def commit(self, index: int):
+        """One yield's object is in the store (in index order)."""
+        with self._cv:
+            if index + 1 > self.committed:
+                self.committed = index + 1
+            gap = self.committed - self.consumed
+            if gap > self.peak_unconsumed:
+                self.peak_unconsumed = gap
+            cbs = list(self._commit_cbs)
+            self._cv.notify_all()
+        oid = stream_item_id(self.task_id, index)
+        for cb in cbs:  # outside the lock: listeners take their own locks
+            cb(index, oid)
+
+    def wait_capacity(self, budget: int,
+                      cancel_event: Optional[threading.Event] = None,
+                      poll_s: float = 0.1) -> bool:
+        """Producer pause point: block while committed-but-unconsumed items
+        have reached ``budget`` (0 = unlimited). Returns False when the
+        stream was cancelled (the yield loop should stop)."""
+        if budget <= 0:
+            return not self.cancelled
+        first = True
+        with self._cv:
+            while (self.committed - self.consumed >= budget
+                   and not self.cancelled):
+                if cancel_event is not None and cancel_event.is_set():
+                    return False
+                if first:
+                    self.paused_events += 1
+                    first = False
+                # The cv wakes on advance_consumed/cancel; the bounded
+                # wait only covers an external cancel_event flip.
+                self._cv.wait(poll_s)
+            return not self.cancelled
+
+    def finish(self, total: int):
+        with self._cv:
+            self.finished = total
+            self._cv.notify_all()
+
+    def set_error(self, exc: BaseException):
+        with self._cv:
+            if self.error is None:
+                self.error = exc
+            self._cv.notify_all()
+
+    def cancel(self):
+        with self._cv:
+            self.cancelled = True
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- consumer
+    def advance_consumed(self, n: int):
+        """Consumption watermark moved to ``n`` (monotonic). On the
+        consumer runtime this fires the ack listeners (wire propagation);
+        on the producer runtime it wakes the paused yield loop — in
+        process-local streams both happen on the same instance."""
+        with self._cv:
+            if n <= self.consumed:
+                return
+            self.consumed = n
+            cbs = list(self._consume_cbs)
+            self._cv.notify_all()
+        for cb in cbs:
+            cb(n)
+
+    def unconsumed(self) -> int:
+        with self._cv:
+            return self.committed - self.consumed
+
+    # ------------------------------------------------------------ listeners
+    def add_commit_listener(self, cb: Callable[[int, ObjectID], None]):
+        with self._cv:
+            self._commit_cbs.append(cb)
+
+    def add_consume_listener(self, cb: Callable[[int], None]):
+        with self._cv:
+            self._consume_cbs.append(cb)
+
+
+class StreamRegistry:
+    """task_id -> StreamState table on a runtime (driver or node)."""
+
+    def __init__(self):
+        self._streams: Dict[TaskID, StreamState] = {}
+        self._lock = threading.Lock()
+
+    def get_or_create(self, task_id: TaskID) -> StreamState:
+        with self._lock:
+            st = self._streams.get(task_id)
+            if st is None:
+                st = self._streams[task_id] = StreamState(task_id)
+            return st
+
+    def get(self, task_id: TaskID) -> Optional[StreamState]:
+        with self._lock:
+            return self._streams.get(task_id)
+
+    def pop(self, task_id: TaskID) -> Optional[StreamState]:
+        with self._lock:
+            return self._streams.pop(task_id, None)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._streams)

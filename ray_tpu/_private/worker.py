@@ -157,6 +157,207 @@ def _deserialize_ref(object_id: ObjectID) -> ObjectRef:
     return ObjectRef(object_id, _add_ref=False)
 
 
+class ObjectRefGenerator:
+    """Iterator over the item ObjectRefs of a ``num_returns="streaming"``
+    task (reference parity: ``ObjectRefGenerator``). Each ``next()``
+    blocks only until the NEXT yield's object commits — locally, or via
+    its ``item_done`` report from the executing node — not until the
+    whole task finishes; returning a ref counts as CONSUMPTION for the
+    producer's backpressure budget. ``close()`` (or dropping the
+    generator) cancels the in-flight task and releases
+    committed-but-unconsumed items. Mid-stream producer death surfaces
+    the typed error at the next ``next()`` (after lineage replay, if
+    any, is exhausted)."""
+
+    def __init__(self, task_id: TaskID, worker: "Worker"):
+        from ray_tpu._private.streaming import stream_end_id
+
+        self._task_id = task_id
+        self._worker = worker
+        self._stream = worker.streams.get_or_create(task_id)
+        self._index = 0
+        self._end_oid = stream_end_id(task_id)
+        self._end_ref = ObjectRef(self._end_oid)
+        self._pending_ref: Optional[ObjectRef] = None
+        self._total: Optional[int] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ iteration
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._next(block=True)
+        assert ref is not None
+        return ref
+
+    def try_next(self) -> Optional[ObjectRef]:
+        """Non-blocking ``next()``: the next item's ref if it is already
+        committed locally, else None. Raises StopIteration / the task's
+        typed error exactly like ``next()``."""
+        return self._next(block=False)
+
+    def completed(self) -> ObjectRef:
+        """The stream's END MARKER ref: ready when the whole generator
+        task finished (value = total yield count; errors raise)."""
+        return self._end_ref
+
+    def wait_refs(self) -> List[ObjectRef]:
+        """Refs to pass to ``ray_tpu.wait`` for "the next ``next()``
+        would make progress": the NEXT item's ref plus the end marker.
+        Lets a scheduler multiplex many streams without blocking on any
+        single one."""
+        return [self._item_ref(), self._end_ref]
+
+    @property
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def _item_ref(self) -> ObjectRef:
+        """The (cached) ref for the CURRENT index — handed out by the
+        next successful ``next()``, so creating it early (for waits)
+        leaks nothing."""
+        from ray_tpu._private.streaming import stream_item_id
+
+        if self._pending_ref is None:
+            self._pending_ref = ObjectRef(
+                stream_item_id(self._task_id, self._index))
+        return self._pending_ref
+
+    def _read_total(self) -> int:
+        """The committed end marker: total count, or the task's typed
+        error re-raised."""
+        serialized = self._worker.store.get(self._end_oid, timeout=5.0)
+        value = self._worker.serialization_context.deserialize(serialized)
+        if isinstance(value, RayTaskError):
+            raise value.as_instanceof_cause()
+        return int(value)
+
+    def _free_unconsumed(self):
+        """Release committed-but-unconsumed item payloads (everything
+        from the consumer's cursor up to the committed/total high-water
+        mark) — the shared teardown step of close() and _fail_closed()."""
+        from ray_tpu._private.streaming import stream_item_id
+
+        upper = self._stream.committed
+        if self._total is not None:
+            upper = max(upper, self._total)
+        drop = [stream_item_id(self._task_id, i)
+                for i in range(self._index, upper)]
+        if drop:
+            self._worker.store.free(drop)
+
+    def _fail_closed(self):
+        """Error-path teardown: the task already finished or failed, so
+        there is nothing to cancel — but committed-but-unconsumed item
+        payloads and the stream's registry entry must still go, or every
+        errored stream pins them forever. Marks the generator closed so
+        close()/__del__ become no-ops."""
+        self._closed = True
+        try:
+            if self._worker.is_alive:
+                self._free_unconsumed()
+        except Exception:  # noqa: BLE001 — cleanup must not mask the error
+            pass
+        finally:
+            self._release_stream()
+
+    def _next(self, block: bool) -> Optional[ObjectRef]:
+        import time as _time
+
+        if self._closed:
+            raise StopIteration
+        store = self._worker.store
+        end_grace: Optional[float] = None
+        while True:
+            item = self._item_ref()
+            oid = item.object_id
+            if store.is_ready(oid):
+                err = store.peek_error(oid)
+                if err is not None:
+                    self._fail_closed()
+                    if hasattr(err, "as_instanceof_cause"):
+                        raise err.as_instanceof_cause()
+                    raise err
+                self._pending_ref = None
+                self._index += 1
+                self._stream.advance_consumed(self._index)
+                return item
+            if self._total is None and store.is_ready(self._end_oid):
+                try:
+                    self._total = self._read_total()
+                except BaseException:
+                    self._fail_closed()
+                    raise
+            if self._total is not None and self._index >= self._total:
+                self._closed = True
+                self._release_stream()
+                raise StopIteration
+            if not block:
+                return None
+            # Remote streams: a large item's bytes stayed on the
+            # producing node (announce + pull) — drive the transfer.
+            router = self._worker.remote_router
+            if router is not None and router.handles(oid) and \
+                    self._index in self._stream.known_remote_sizes:
+                router.prefetch(oid)
+            if self._total is not None:
+                # Task DONE but item i < total is not local: its bytes
+                # are still in flight (pull) — or lost with no producer
+                # left. Bound the wait so a lost item cannot hang us.
+                if end_grace is None:
+                    end_grace = _time.monotonic() + (
+                        30.0 if router is not None else 5.0)
+                elif _time.monotonic() > end_grace:
+                    from ray_tpu.exceptions import ObjectLostError
+
+                    self._fail_closed()
+                    raise ObjectLostError(
+                        f"streaming item {self._index} of task "
+                        f"{self._task_id.hex()[:16]}… completed but its "
+                        f"bytes are no longer retrievable")
+            store.wait([oid, self._end_oid], 1, 0.2)
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        """Cancel the in-flight generator task and release
+        committed-but-unconsumed items. Idempotent; also runs when the
+        generator is garbage-collected before exhaustion."""
+        if self._closed:
+            return
+        self._closed = True
+        w = self._worker
+        if not w.is_alive:
+            return
+        stream = self._stream
+        try:
+            if not w.store.is_ready(self._end_oid):
+                stream.cancel()
+                router = w.remote_router
+                if router is not None and router.handles(self._end_oid):
+                    router.cancel_stream(self._task_id)
+                w.scheduler.cancel(self._task_id)
+                # Materialize the typed cancellation end so any other
+                # waiter (ray_tpu.wait on completed()) unblocks.
+                w.store.cancel(self._end_oid, self._task_id)
+            self._free_unconsumed()
+        finally:
+            self._release_stream()
+
+    def _release_stream(self):
+        self._worker.streams.pop(self._task_id)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator({self._task_id.hex()[:16]}…, "
+                f"next={self._index})")
+
+
 class Worker:
     def __init__(self, num_cpus: Optional[int] = None,
                  num_tpus: Optional[int] = None,
@@ -195,6 +396,11 @@ class Worker:
         spill_dir = GlobalConfig.object_spill_dir or os.path.join(
             self.session_dir, "spill")
         self.store = ObjectStore(spill_dir)
+        # Streaming-generator plane: per-task stream state (yield commit
+        # counters, backpressure watermarks) for num_returns="streaming".
+        from ray_tpu._private.streaming import StreamRegistry
+
+        self.streams = StreamRegistry()
         self.task_events = TaskEventBuffer(GlobalConfig.task_events_max_buffer)
         if num_cpus is None:
             num_cpus = os.cpu_count() or 1

@@ -110,9 +110,44 @@ def _run_dag_stages(store, desc: dict, actor_instance) -> None:
               + traceback.format_exc(), file=sys.stderr, flush=True)
 
 
+def _run_stream_yields(gen, ctx, max_msg: int, stage_result, emit,
+                       budget: int, wait_acks):
+    """Producer yield loop shared by every process-plane stream flavor
+    (task_stream, actor_stream, mux actor items): serialize each yield,
+    ``emit`` it (small items inline in the frame, big items staged in the
+    shm store), then run the pause protocol — ``wait_acks(count)`` blocks
+    while committed-but-unconsumed items have reached ``budget`` and
+    returns False when the consumer cancelled. Returns
+    ``(total, cancelled)``."""
+    limit = max(max_msg // 4, 64 * 1024)
+    if not hasattr(gen, "__iter__") and not hasattr(gen, "__next__"):
+        raise TypeError(
+            f"streaming task returned non-iterable {type(gen).__name__}")
+    it = iter(gen)
+    idx = 0
+    try:
+        for item in it:
+            raw = ctx.serialize(item).to_bytes()
+            field = ("shm", stage_result(raw)) if len(raw) > limit else raw
+            emit(idx, field)
+            idx += 1
+            if not wait_acks(idx):
+                return idx, True
+    except BaseException:
+        close = getattr(it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — generator cleanup
+                pass
+        raise
+    return idx, False
+
+
 def worker_loop(store_name: str, req_id: int, rep_id: int,
                 worker_id: int, max_msg: int,
-                api_req_id: int = 0, api_rep_id: int = 0) -> None:
+                api_req_id: int = 0, api_rep_id: int = 0,
+                ack_id: int = 0) -> None:
     # Workers never touch the TPU: the device belongs to the driver (the
     # compiled-graph path); keep jax (if imported by user code) on CPU.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -131,6 +166,13 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                                num_readers=1, create=False)
     rep = NativeMutableChannel(store, rep_id, max_size=max_msg,
                                num_readers=1, create=False)
+    ack = None
+    if ack_id:
+        # Streaming backpressure acks (driver -> this worker); read only
+        # inside a stream's pause/poll points, so it never interleaves
+        # with the request protocol.
+        ack = NativeMutableChannel(store, ack_id, max_size=8192,
+                                   num_readers=1, create=False)
 
     # Install the client-mode runtime so ray_tpu.* API calls made inside
     # task/actor code forward to the driver instead of booting a second
@@ -188,8 +230,19 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
             err = RayTaskError(str(name), traceback.format_exc(), cause=None)
             _reply(("calldone", call_id, "err", pickle.dumps(err)))
 
+    def _stream_actor_result(call_id, result, task_id_bin, budget,
+                             wait_acks):
+        """Emit one actor call's generator result as mux item frames."""
+        total, cancelled = _run_stream_yields(
+            result, ctx, max_msg, _stage_result,
+            lambda i, f: _reply(("calldone", call_id, "item", (i, f))),
+            budget, wait_acks)
+        _reply(("calldone", call_id,
+                "cancelled" if cancelled else "ok_stream", total))
+
     def _run_actor_call_sync(call_id, method_name, payload, return_keys,
-                             num_returns, task_id_bin, name):
+                             num_returns, task_id_bin, name,
+                             stream_budget=None):
         try:
             method = getattr(actor_instance, method_name)
             args, kwargs = _load_payload(store, ctx,
@@ -197,15 +250,22 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
             _set_task_ctx(task_id_bin, name)
             try:
                 result = method(*args, **kwargs)
+                if stream_budget is not None:
+                    _stream_actor_result(
+                        call_id, result, task_id_bin, stream_budget,
+                        _mux_ack_waiter(task_id_bin, stream_budget))
+                    return
             finally:
                 _set_task_ctx(None, None)
+                if stream_budget is not None:
+                    _mux_stream_done(task_id_bin)
             _finish_actor_call(call_id, result, return_keys, num_returns)
         except BaseException as exc:  # noqa: BLE001 — call error boundary
             _fail_actor_call(call_id, name, exc)
 
     async def _run_actor_call_async(call_id, method_name, payload,
                                     return_keys, num_returns, task_id_bin,
-                                    name):
+                                    name, stream_budget=None):
         import inspect as _inspect
 
         try:
@@ -217,16 +277,133 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 result = method(*args, **kwargs)
                 if _inspect.iscoroutine(result):
                     result = await result
+                if stream_budget is not None:
+                    if hasattr(result, "__anext__"):
+                        await _stream_actor_result_async(
+                            call_id, result, task_id_bin, stream_budget)
+                    else:
+                        # Sync generator from an async actor: iterate on
+                        # the executor so the event loop stays live.
+                        import asyncio as _asyncio
+
+                        await _asyncio.get_running_loop().run_in_executor(
+                            None, _stream_actor_result, call_id, result,
+                            task_id_bin, stream_budget,
+                            _mux_ack_waiter(task_id_bin, stream_budget))
+                    return
             finally:
                 _set_task_ctx(None, None)
+                if stream_budget is not None:
+                    _mux_stream_done(task_id_bin)
             _finish_actor_call(call_id, result, return_keys, num_returns)
         except BaseException as exc:  # noqa: BLE001 — call error boundary
             _fail_actor_call(call_id, name, exc)
+
+    async def _stream_actor_result_async(call_id, agen, task_id_bin,
+                                         budget):
+        """Async-generator flavor of the mux item stream (pause points
+        poll the ack table without blocking the event loop)."""
+        import asyncio as _asyncio
+
+        limit = max(max_msg // 4, 64 * 1024)
+        key = bytes(task_id_bin)
+        idx = 0
+        cancelled = False
+        async for item in agen:
+            raw = ctx.serialize(item).to_bytes()
+            field = ("shm", _stage_result(raw)) if len(raw) > limit \
+                else raw
+            _reply(("calldone", call_id, "item", (idx, field)))
+            idx += 1
+            while True:
+                with _stream_ack_cv:
+                    if key in _stream_cancels:
+                        cancelled = True
+                        break
+                    if not budget or \
+                            idx - _stream_acks.get(key, 0) < budget:
+                        break
+                await _asyncio.sleep(0.02)
+            if cancelled:
+                break
+        _reply(("calldone", call_id,
+                "cancelled" if cancelled else "ok_stream", idx))
 
     def _set_task_ctx(task_id_bin, name):
         worker_mod._task_context.current_task_id = (
             TaskID(task_id_bin) if task_id_bin else None)
         worker_mod._task_context.task_name = name
+
+    # ------------------------------------------------- streaming producers
+    # Mux actors receive acks as ("stream_ack", tid_bin, n) REQUESTS on
+    # the req channel (the main loop below drains it continuously); the
+    # single-flight planes (task_stream / actor_stream) read the dedicated
+    # ack channel inside their pause loop.
+    _stream_acks: Dict[bytes, int] = {}
+    _stream_cancels: set = set()
+    _stream_ack_cv = _threading_mod.Condition()
+
+    def _ack_chan_waiter(tid_bin: bytes, budget: int):
+        """wait_acks over the dedicated ack channel (task_stream /
+        actor_stream): drain opportunistically between yields, block at
+        the budget. Stale acks from a previous stream on this worker are
+        read and ignored (tid-tagged)."""
+        acked = [0]
+        cancelled = [False]
+
+        def _drain(timeout: float) -> bool:
+            if ack is None:
+                return False
+            try:
+                m = ack.read(timeout=timeout)
+            except ChannelTimeoutError:
+                return False
+            except ChannelError:
+                cancelled[0] = True  # driver tore the channel down
+                return False
+            if m and m[0] == "stream_ack" and bytes(m[1]) == tid_bin:
+                n = m[2]
+                if n < 0:
+                    cancelled[0] = True
+                elif n > acked[0]:
+                    acked[0] = n
+            return True
+
+        def wait_acks(count: int) -> bool:
+            while _drain(0.001):
+                pass
+            while budget and count - acked[0] >= budget \
+                    and not cancelled[0]:
+                if not _drain(0.2) and os.getppid() == 1:
+                    cancelled[0] = True  # orphaned: driver died
+            return not cancelled[0]
+
+        return wait_acks
+
+    def _mux_ack_waiter(tid_bin: bytes, budget: int):
+        """wait_acks over the main-loop-maintained ack table (mux
+        actors: many streams share one worker process)."""
+        key = bytes(tid_bin)
+
+        def wait_acks(count: int) -> bool:
+            with _stream_ack_cv:
+                while True:
+                    if key in _stream_cancels:
+                        return False
+                    if not budget or \
+                            count - _stream_acks.get(key, 0) < budget:
+                        return True
+                    _stream_ack_cv.wait(0.2)
+                    if os.getppid() == 1:
+                        return False
+
+        return wait_acks
+
+    def _mux_stream_done(tid_bin: bytes):
+        key = bytes(tid_bin)
+        with _stream_ack_cv:
+            _stream_acks.pop(key, None)
+            _stream_cancels.discard(key)
 
     while True:
         try:
@@ -330,7 +507,8 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                     _reply(("ok", None))
             elif kind == "actor_submit":
                 (_, call_id, method_name, payload, return_keys,
-                 num_returns, task_id_bin, name) = msg
+                 num_returns, task_id_bin, name) = msg[:8]
+                stream_budget = msg[8] if len(msg) > 8 else None
                 if actor_instance is None:
                     _fail_actor_call(call_id, name, RuntimeError(
                         "actor_submit before actor_new2"))
@@ -345,18 +523,83 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                                      payload=payload,
                                      return_keys=return_keys,
                                      num_returns=num_returns,
-                                     task_id_bin=task_id_bin, name=name):
+                                     task_id_bin=task_id_bin, name=name,
+                                     stream_budget=stream_budget):
                         async with sem:
                             await _run_actor_call_async(
                                 call_id, method_name, payload, return_keys,
-                                num_returns, task_id_bin, name)
+                                num_returns, task_id_bin, name,
+                                stream_budget)
 
                     _asyncio.run_coroutine_threadsafe(_gated(), loop)
                 else:
                     actor_state["pool"].submit(
                         _run_actor_call_sync, call_id, method_name,
                         payload, return_keys, num_returns, task_id_bin,
-                        name)
+                        name, stream_budget)
+            elif kind == "stream_ack":
+                # Mux-actor backpressure: consumption watermark (n >= 0)
+                # or cancel (n < 0) for one in-flight stream. Fire and
+                # forget — no reply.
+                _, tid_bin, n = msg
+                key = bytes(tid_bin)
+                with _stream_ack_cv:
+                    if n < 0:
+                        _stream_cancels.add(key)
+                    elif n > _stream_acks.get(key, 0):
+                        _stream_acks[key] = n
+                    _stream_ack_cv.notify_all()
+            elif kind == "task_stream":
+                (_, digest, fn_bytes, payload, task_id_bin, name,
+                 env_fields, budget) = msg
+                fn = fn_cache.get(digest)
+                if fn is None:
+                    fn = cloudpickle.loads(_fetch_blob(store, fn_bytes))
+                    fn_cache[digest] = fn
+                args, kwargs = _load_payload(store, ctx,
+                                             _fetch_blob(store, payload))
+                _set_task_ctx(task_id_bin, name)
+                try:
+                    def _go():
+                        gen = fn(*args, **kwargs)
+                        total, was_cancelled = _run_stream_yields(
+                            gen, ctx, max_msg, _stage_result,
+                            lambda i, f: _reply(("item", i, f)),
+                            budget,
+                            _ack_chan_waiter(bytes(task_id_bin), budget))
+                        _reply(("cancelled",) if was_cancelled
+                               else ("ok", total))
+
+                    if env_fields:
+                        renv = _cached_runtime_env(env_fields)
+                        with renv.applied():
+                            _go()
+                    else:
+                        _go()
+                finally:
+                    _set_task_ctx(None, None)
+            elif kind == "actor_stream":
+                # Streaming method on a sync (non-mux) process actor: the
+                # same wire shape as task_stream, generator from the
+                # resident instance.
+                (_, method_name, payload, task_id_bin, name, budget) = msg
+                if actor_instance is None:
+                    raise RuntimeError("actor_stream before actor_new")
+                method = getattr(actor_instance, method_name)
+                args, kwargs = _load_payload(store, ctx,
+                                             _fetch_blob(store, payload))
+                _set_task_ctx(task_id_bin, name)
+                try:
+                    gen = method(*args, **kwargs)
+                    total, was_cancelled = _run_stream_yields(
+                        gen, ctx, max_msg, _stage_result,
+                        lambda i, f: _reply(("item", i, f)),
+                        budget, _ack_chan_waiter(bytes(task_id_bin),
+                                                 budget))
+                    _reply(("cancelled",) if was_cancelled
+                           else ("ok", total))
+                finally:
+                    _set_task_ctx(None, None)
             elif kind == "actor_call":
                 (_, method_name, payload, return_keys, num_returns,
                  task_id_bin, name) = msg
@@ -385,7 +628,12 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
             else:
                 raise ValueError(f"unknown request kind {kind!r}")
         except BaseException as exc:  # noqa: BLE001 — worker error boundary
-            name = msg[1] if kind == "actor_call" else "task"
+            if kind in ("actor_call", "actor_stream"):
+                name = msg[1]
+            elif kind == "task_stream":
+                name = msg[5]
+            else:
+                name = "task"
             try:
                 err = RayTaskError.from_exception(str(name), exc)
                 _reply(("err", pickle.dumps(err)))
@@ -423,11 +671,13 @@ def main(argv=None) -> int:
     ap.add_argument("--rep-id", type=int, required=True)
     ap.add_argument("--api-req-id", type=int, default=0)
     ap.add_argument("--api-rep-id", type=int, default=0)
+    ap.add_argument("--ack-id", type=int, default=0)
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--max-msg", type=int, default=4 << 20)
     args = ap.parse_args(argv)
     worker_loop(args.store, args.req_id, args.rep_id, args.worker_id,
-                args.max_msg, args.api_req_id, args.api_rep_id)
+                args.max_msg, args.api_req_id, args.api_rep_id,
+                args.ack_id)
     return 0
 
 

@@ -85,10 +85,17 @@ class WorkerProcess:
         self._rep_id = base | 2
         self._api_req_id = base | 3
         self._api_rep_id = base | 5
+        self._ack_id = base | 6
         self._req = NativeMutableChannel(
             store, self._req_id, max_size=max_msg, num_readers=1)
         self._rep = NativeMutableChannel(
             store, self._rep_id, max_size=max_msg, num_readers=1)
+        # Streaming backpressure acks (driver -> worker): a dedicated tiny
+        # channel so consumption watermarks never interleave with task
+        # requests on the req channel (a stale unread ack there would be
+        # read as the next request and desync the protocol).
+        self._ack = NativeMutableChannel(
+            store, self._ack_id, max_size=8192, num_readers=1)
         # Reverse API channel pair: ray_tpu.* calls made inside the worker
         # forward to the driver's service thread (driver_service.py).
         self._api_req = NativeMutableChannel(
@@ -102,6 +109,7 @@ class WorkerProcess:
             "--rep-id", str(self._rep_id),
             "--api-req-id", str(self._api_req_id),
             "--api-rep-id", str(self._api_rep_id),
+            "--ack-id", str(self._ack_id),
             "--worker-id", str(self.worker_id),
             "--max-msg", str(max_msg),
         ]
@@ -225,7 +233,8 @@ class WorkerProcess:
             self.kill()
         self._svc_thread.join(timeout=1.0)
         # The worker is dead: reclaim the channel arenas in the shm store.
-        for ch in (self._req, self._rep, self._api_req, self._api_rep):
+        for ch in (self._req, self._rep, self._api_req, self._api_rep,
+                   self._ack):
             ch.destroy()
 
 
@@ -468,7 +477,7 @@ class WorkerPool:
             if w.proc.poll() is None:
                 w.kill()
             w._svc_thread.join(timeout=0.5)
-            for ch in (w._req, w._rep, w._api_req, w._api_rep):
+            for ch in (w._req, w._rep, w._api_req, w._api_rep, w._ack):
                 try:
                     ch.destroy()
                 except Exception:  # noqa: BLE001

@@ -44,15 +44,20 @@ class _ClosureCall:
 
 class _MethodCall:
     __slots__ = ("method_name", "args", "kwargs", "return_ids", "name",
-                 "cancelled")
+                 "cancelled", "streaming", "backpressure")
 
-    def __init__(self, method_name, args, kwargs, return_ids, name):
+    def __init__(self, method_name, args, kwargs, return_ids, name,
+                 streaming: bool = False, backpressure: int = 0):
         self.method_name = method_name
         self.args = args
         self.kwargs = kwargs
         self.return_ids = return_ids
         self.name = name
         self.cancelled = False
+        # Generator method (num_returns="streaming"): return_ids holds
+        # only the stream END MARKER; items commit per yield.
+        self.streaming = streaming
+        self.backpressure = backpressure
 
 
 class _ActorRuntime:
@@ -76,7 +81,7 @@ class _ActorRuntime:
         self._seq_counter = 0
         self._lock = threading.Lock()
         self.is_async = any(
-            inspect.iscoroutinefunction(m)
+            inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
             for _, m in inspect.getmembers(cls, inspect.isfunction)
         )
         # Default concurrency: async actors interleave up to 1000 coroutines
@@ -408,16 +413,39 @@ class _ActorRuntime:
                     shm.delete(key)
                 except Exception:  # noqa: BLE001
                     pass
+            entry = {"call": call, "staged": staged, "ret_keys": ret_keys}
+            stream_budget = None
+            if call.streaming:
+                # Item frames come back multiplexed as
+                # ("calldone", call_id, "item", ...); consumption acks go
+                # out as fire-and-forget stream_ack requests — the worker
+                # main loop drains the req channel continuously, so no
+                # dedicated ack channel is needed on the mux plane.
+                stream_budget = int(call.backpressure)
+                stream = worker.streams.get_or_create(task_id)
+                entry["stream"] = stream
+                entry["cancel_sent"] = False
+                proc = self._proc
+                tid_bin = task_id.binary()
+
+                def _wire_ack(n, _p=proc, _t=tid_bin, _e=entry):
+                    try:
+                        _p._req.write(("stream_ack", _t, int(n)),
+                                      timeout=5.0)
+                        if n > _e.get("acked", 0):
+                            _e["acked"] = n
+                    except Exception:  # noqa: BLE001 — dropped ack: the
+                        pass           # pump's watermark re-send retries
+                stream.add_consume_listener(_wire_ack)
+                entry["wire_ack"] = _wire_ack
             with self._mux_lock:
                 self._mux_call_counter += 1
                 call_id = self._mux_call_counter
-                self._mux_pending[call_id] = {
-                    "call": call, "staged": staged, "ret_keys": ret_keys,
-                }
+                self._mux_pending[call_id] = entry
             self._proc._req.write(
                 ("actor_submit", call_id, call.method_name, payload,
                  ret_keys, len(call.return_ids), task_id.binary(),
-                 call.name), timeout=60.0)
+                 call.name, stream_budget), timeout=60.0)
         except BaseException as exc:  # noqa: BLE001 — dispatch boundary
             with self._mux_lock:
                 if call_id is not None:
@@ -450,6 +478,8 @@ class _ActorRuntime:
         from ray_tpu._private.serialization import SerializedObject
         from ray_tpu.exceptions import ChannelError, ChannelTimeoutError
 
+        from ray_tpu._private.streaming import stream_end_id, stream_item_id
+
         shm = worker.shm_store
         while True:
             try:
@@ -457,12 +487,41 @@ class _ActorRuntime:
             except ChannelTimeoutError:
                 if not proc.alive() or proc is not self._proc:
                     break
+                self._mux_propagate_cancels(proc)
+                self._mux_resend_watermarks(proc)
                 continue
             except (ChannelError, Exception):  # noqa: BLE001 — torn down
                 break
             if not msg or msg[0] != "calldone":
                 continue
             _, call_id, status, value = msg
+            if status == "item":
+                # Mid-stream yield: commit the item WITHOUT popping the
+                # pending entry (the stream is still in flight).
+                with self._mux_lock:
+                    entry = self._mux_pending.get(call_id)
+                stream = (entry or {}).get("stream")
+                if stream is None:
+                    continue  # stale frame from a replaced worker
+                try:
+                    idx, field = value
+                    if isinstance(field, (tuple, list)) and field and \
+                            field[0] == "shm":
+                        raw = bytes(shm.get(field[1]))
+                        try:
+                            shm.delete(field[1])
+                        except Exception:  # noqa: BLE001
+                            pass
+                    else:
+                        raw = bytes(field)
+                    tid = entry["call"].return_ids[0].task_id()
+                    worker.store.put(stream_item_id(tid, int(idx)),
+                                     SerializedObject.from_bytes(raw))
+                    stream.commit(int(idx))
+                except Exception:  # noqa: BLE001 — item frame corrupt:
+                    pass           # the terminal frame settles the call
+                self._mux_propagate_cancels(proc)
+                continue
             with self._mux_lock:
                 entry = self._mux_pending.pop(call_id, None)
             if entry is None:
@@ -483,6 +542,18 @@ class _ActorRuntime:
                     worker.task_events.record(
                         call.return_ids[0].task_id(), "FINISHED",
                         name=call.name)
+                elif status == "ok_stream":
+                    tid = call.return_ids[0].task_id()
+                    total = int(value)
+                    worker.store.put(
+                        stream_end_id(tid),
+                        worker.serialization_context.serialize(total))
+                    entry["stream"].finish(total)
+                    worker.task_events.record(tid, "FINISHED",
+                                              name=call.name)
+                elif status == "cancelled":
+                    self._fail_call(worker, call, TaskCancelledError(
+                        call.return_ids[0].task_id()))
                 elif status == "err":
                     self._fail_call(worker, call, _pickle.loads(value))
                     worker.task_events.record(
@@ -522,6 +593,48 @@ class _ActorRuntime:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def _mux_propagate_cancels(self, proc):
+        """A consumer dropped its generator mid-stream: signal the worker
+        (once per call) so its yield loop stops between yields."""
+        with self._mux_lock:
+            entries = [e for e in self._mux_pending.values()
+                       if e.get("stream") is not None
+                       and e["stream"].cancelled
+                       and not e.get("cancel_sent")]
+            for e in entries:
+                e["cancel_sent"] = True
+        for e in entries:
+            try:
+                proc._req.write(
+                    ("stream_ack",
+                     e["call"].return_ids[0].task_id().binary(), -1),
+                    timeout=1.0)
+            except Exception:  # noqa: BLE001 — worker died: pump exits
+                pass
+
+    def _mux_resend_watermarks(self, proc):
+        """Ack-loss recovery: _wire_ack is fire-and-forget, so a single
+        timed-out write would otherwise park a backpressured stream
+        forever (producer waits for a watermark that never arrives). On
+        pump-idle slices, re-send any consumption watermark ahead of the
+        last delivered one."""
+        with self._mux_lock:
+            stale = [(e, e["stream"].consumed)
+                     for e in self._mux_pending.values()
+                     if e.get("stream") is not None
+                     and not e["stream"].cancelled
+                     and e["stream"].consumed > e.get("acked", 0)]
+        for e, n in stale:
+            try:
+                proc._req.write(
+                    ("stream_ack",
+                     e["call"].return_ids[0].task_id().binary(), int(n)),
+                    timeout=1.0)
+                if n > e.get("acked", 0):
+                    e["acked"] = n
+            except Exception:  # noqa: BLE001 — retried next idle slice
+                pass
+
     def _execute_call_proc(self, worker, call: _MethodCall):
         from ray_tpu._private.serialization import SerializedObject
         from ray_tpu._private.worker_pool import (
@@ -546,6 +659,23 @@ class _ActorRuntime:
             payload, st = maybe_stage(
                 shm, payload, max(self._proc.max_msg // 4, 64 * 1024))
             staged += st
+            if call.streaming:
+                # Generator method on a sync process actor: the same
+                # item-frame pump as streaming tasks (pause protocol in
+                # worker_main, acks on the stream-ack channel).
+                from ray_tpu._private.scheduler import pump_stream_replies
+
+                stream = worker.streams.get_or_create(task_id)
+                self._proc._req.write(
+                    ("actor_stream", call.method_name, payload,
+                     task_id.binary(), call.name,
+                     int(call.backpressure)), timeout=60.0)
+                pump_stream_replies(
+                    self._proc, task_id, call.name, stream, worker.store,
+                    shm, worker.serialization_context)
+                worker.task_events.record(task_id, "FINISHED",
+                                          name=call.name)
+                return
             for key in ret_keys:  # clear stale keys from a crashed attempt
                 try:
                     shm.delete(key)
@@ -563,7 +693,7 @@ class _ActorRuntime:
             self._on_proc_crash(worker, call, e)
             worker.task_events.record(task_id, "FAILED", name=call.name)
         except BaseException as exc:  # noqa: BLE001 — method error boundary
-            if isinstance(exc, RayTaskError):
+            if isinstance(exc, (RayTaskError, TaskCancelledError)):
                 self._fail_call(worker, call, exc)
             else:
                 self._fail_call(
@@ -710,7 +840,10 @@ class _ActorRuntime:
             method = getattr(self.instance, call.method_name)
             args, kwargs = _resolve_actor_args(worker, call)
             result = method(*args, **kwargs)
-            self._store_outputs(worker, call, result)
+            if call.streaming:
+                self._stream_call_outputs(worker, call, result)
+            else:
+                self._store_outputs(worker, call, result)
             worker.task_events.record(
                 call.return_ids[0].task_id(), "FINISHED", name=call.name)
         except BaseException as exc:  # noqa: BLE001 — method error boundary
@@ -718,6 +851,40 @@ class _ActorRuntime:
                 worker, call, RayTaskError.from_exception(call.name, exc))
             worker.task_events.record(
                 call.return_ids[0].task_id(), "FAILED", name=call.name)
+
+    def _stream_call_outputs(self, worker, call: _MethodCall, result):
+        """In-driver generator method: commit one object per yield (the
+        consumer's next() unblocks immediately), pausing at the
+        backpressure budget; a dropped/closed consumer generator cancels
+        the loop between yields."""
+        from ray_tpu._private.streaming import stream_end_id, stream_item_id
+
+        task_id = call.return_ids[0].task_id()
+        stream = worker.streams.get_or_create(task_id)
+        ctx = worker.serialization_context
+        idx = 0
+        it = iter(result)
+        try:
+            for item in it:
+                if call.cancelled or stream.cancelled:
+                    raise TaskCancelledError(task_id)
+                worker.store.put(stream_item_id(task_id, idx),
+                                 ctx.serialize(item))
+                stream.commit(idx)
+                idx += 1
+                if not stream.wait_capacity(call.backpressure):
+                    raise TaskCancelledError(task_id)
+        except BaseException as exc:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — generator cleanup
+                    pass
+            stream.set_error(exc)
+            raise
+        worker.store.put(stream_end_id(task_id), ctx.serialize(idx))
+        stream.finish(idx)
 
     async def _execute_call_async(self, worker, call: _MethodCall):
         if call.cancelled:
@@ -729,10 +896,50 @@ class _ActorRuntime:
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
+            if call.streaming:
+                if hasattr(result, "__anext__"):
+                    await self._stream_call_outputs_async(
+                        worker, call, result)
+                else:
+                    # Sync generator from an async actor: iterate on the
+                    # loop's executor so coroutines stay responsive.
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(
+                        None, self._stream_call_outputs, worker, call,
+                        result)
+                return
             self._store_outputs(worker, call, result)
         except BaseException as exc:  # noqa: BLE001
             self._fail_call(
                 worker, call, RayTaskError.from_exception(call.name, exc))
+
+    async def _stream_call_outputs_async(self, worker, call: _MethodCall,
+                                         agen):
+        """Async-generator flavor: pause points poll the stream state
+        without blocking the actor's event loop."""
+        from ray_tpu._private.streaming import stream_end_id, stream_item_id
+
+        task_id = call.return_ids[0].task_id()
+        stream = worker.streams.get_or_create(task_id)
+        ctx = worker.serialization_context
+        idx = 0
+        try:
+            async for item in agen:
+                if call.cancelled or stream.cancelled:
+                    raise TaskCancelledError(task_id)
+                worker.store.put(stream_item_id(task_id, idx),
+                                 ctx.serialize(item))
+                stream.commit(idx)
+                idx += 1
+                while call.backpressure and not stream.cancelled and \
+                        stream.committed - stream.consumed >= \
+                        call.backpressure:
+                    await asyncio.sleep(0.01)
+        except BaseException as exc:
+            stream.set_error(exc)
+            raise
+        worker.store.put(stream_end_id(task_id), ctx.serialize(idx))
+        stream.finish(idx)
 
     def _store_outputs(self, worker, call: _MethodCall, result):
         ctx = worker.serialization_context
@@ -780,6 +987,32 @@ class _ActorRuntime:
         ]
         return self.submit_prepared(method_name, args, kwargs, return_ids,
                                     name)
+
+    def submit_stream(self, method_name: str, args, kwargs, name: str):
+        """Submit a generator method (num_returns="streaming"): returns an
+        ObjectRefGenerator whose item refs materialize per yield."""
+        from ray_tpu._private.streaming import stream_end_id
+        from ray_tpu._private.worker import ObjectRefGenerator
+
+        worker = global_worker()
+        with self._lock:
+            self._seq_counter += 1
+            task_id = TaskID.for_actor_task(self.actor_id, self._seq_counter)
+        return_ids = [stream_end_id(task_id)]
+        worker.store.mark_local_producer(return_ids[0])
+        gen = ObjectRefGenerator(task_id, worker)
+        if self.dead:
+            err = ActorDiedError(self.actor_id,
+                                 self.death_cause or "actor is dead")
+            worker.store.put_error(return_ids[0], err)
+            return gen
+        worker.task_events.record(task_id, "PENDING_ACTOR_TASK", name=name)
+        call = _MethodCall(
+            method_name, args, kwargs, return_ids, name, streaming=True,
+            backpressure=GlobalConfig.generator_backpressure_items)
+        with self._lock:
+            self._mailbox.put(call)
+        return gen
 
     def submit_prepared(self, method_name: str, args, kwargs,
                         return_ids, name: str):
@@ -966,6 +1199,14 @@ class ActorMethod:
         name = self._options.get(
             "name",
             f"{self._runtime.class_name}.{self._method_name}")
+        if num_returns == "streaming":
+            submit_stream = getattr(self._runtime, "submit_stream", None)
+            if submit_stream is None:
+                raise ValueError(
+                    "num_returns='streaming' is not supported on "
+                    "cluster-placed (remote-node) actors yet; use a "
+                    "streaming task, or the serve KV stream fallback")
+            return submit_stream(self._method_name, args, kwargs, name)
         refs = self._runtime.submit(
             self._method_name, args, kwargs, num_returns, name)
         return refs[0] if num_returns == 1 else refs

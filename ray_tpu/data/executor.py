@@ -74,8 +74,37 @@ def _put_blocks_remote(blocks: List[Block]) -> List[RefBundle]:
     return out
 
 
+def _stream_blocks_remote(blocks) -> Iterator[RefBundle]:
+    """Task-side block publication on the STREAMING plane: each output
+    block is put task-side and its (ref, rows) metadata yielded — one
+    committed item per block, so the driver harvests block 0 (and
+    downstream operators dispatch on it) while this task is still
+    producing block 1."""
+    for b in blocks:
+        yield (ray_tpu.put(b), block_num_rows(b))
+
+
+def _drain_stream(gen) -> Tuple[List[RefBundle], bool]:
+    """Non-blocking incremental harvest of one map/read task's item
+    stream: returns the bundles whose yields have committed so far and
+    whether the stream is exhausted. Unlike the old num_returns-list
+    protocol, bundles are consumable BEFORE the producing task finishes."""
+    bundles: List[RefBundle] = []
+    while True:
+        try:
+            ref = gen.try_next()
+        except StopIteration:
+            return bundles, True
+        if ref is None:
+            return bundles, False
+        bundles.append(tuple(ray_tpu.get(ref)))
+
+
 class MapOperator(Operator):
-    """Streaming task-pool map over block refs."""
+    """Streaming task-pool map over block refs. Map tasks run with
+    ``num_returns="streaming"``: every output block's (ref, rows)
+    metadata commits per yield, so a multi-block map task feeds its
+    downstream operator block by block instead of at task completion."""
 
     streaming = True
 
@@ -88,7 +117,7 @@ class MapOperator(Operator):
 
         @ray_tpu.remote
         def _apply(block):
-            return _put_blocks_remote(fn(block))
+            yield from _stream_blocks_remote(fn(block))
 
         self._task = _apply
 
@@ -98,14 +127,18 @@ class MapOperator(Operator):
 
     def dispatch(self, item: RefBundle):
         ref, _ = item
-        return self._task.remote(ref)
+        return self._task.options(num_returns="streaming").remote(ref)
 
-    def harvest(self, out_ref) -> List[RefBundle]:
-        return list(ray_tpu.get(out_ref))  # metadata only: [(ref, rows)]
+    def harvest(self, gen) -> List[RefBundle]:
+        """Blocking harvest of a whole stream (compat entry point; the
+        scheduling loop uses incremental ``_drain_stream``)."""
+        return [tuple(ray_tpu.get(r)) for r in gen]
 
 
 class InputOperator(Operator):
-    """Source: produces blocks from read tasks (executed remotely)."""
+    """Source: produces blocks from read tasks (executed remotely on the
+    streaming plane — the first block of a many-block read task is
+    downstream-visible before the read finishes)."""
 
     streaming = True
 
@@ -118,7 +151,7 @@ class InputOperator(Operator):
 
         @ray_tpu.remote
         def _read(task):
-            return _put_blocks_remote(task())
+            yield from _stream_blocks_remote(task())
 
         self._task = _read
 
@@ -126,10 +159,11 @@ class InputOperator(Operator):
         return len(self._read_tasks)
 
     def dispatch(self, item):
-        return self._task.remote(item)  # item is a read-task callable
+        # item is a read-task callable
+        return self._task.options(num_returns="streaming").remote(item)
 
-    def harvest(self, out_ref) -> List[RefBundle]:
-        return list(ray_tpu.get(out_ref))
+    def harvest(self, gen) -> List[RefBundle]:
+        return [tuple(ray_tpu.get(r)) for r in gen]
 
 
 def _compose_block_fns(f, g):
@@ -214,25 +248,65 @@ class ShuffleOperator(Operator):
 
         @ray_tpu.remote
         def _map(block, idx):
-            parts = part(block, P, idx)
-            return tuple(parts) if P > 1 else parts[0]
+            # Streaming partition emission: part p's ref commits as soon
+            # as it is yielded, so reduce p dispatches while this task is
+            # still emitting parts p+1..P-1 (replaces the static
+            # num_returns=P pre-allocation).
+            for p_block in part(block, P, idx):
+                yield p_block
 
         @ray_tpu.remote
         def _reduce(p, *parts):
             return _put_blocks_remote(red(list(parts), p))
 
-        map_refs = []
-        for i, ref in enumerate(in_refs):
-            if P > 1:
-                map_refs.append(
-                    _map.options(num_returns=P).remote(ref, i))
-            else:
-                map_refs.append([_map.remote(ref, i)])
+        map_gens = [
+            _map.options(num_returns="streaming").remote(ref, i)
+            for i, ref in enumerate(in_refs)
+        ]
         out: List[RefBundle] = []
         rows = 0
-        reduce_refs = [
-            _reduce.remote(p, *[m[p] for m in map_refs]) for p in range(P)
-        ]
+        reduce_refs = []
+        # Opportunistic harvest instead of lockstep next(): with a
+        # backpressure budget < P, maps holding every worker slot park at
+        # the budget while a not-yet-scheduled map's first yield is
+        # awaited — a strict round-robin next() deadlocks there. Draining
+        # whichever map has committed parts keeps every producer's acks
+        # flowing; reduce p still launches on every map's p-th yield.
+        parts: List[List] = [[] for _ in map_gens]
+        done = [False] * len(map_gens)
+        next_p = 0
+        while next_p < P:
+            progressed = False
+            for mi, gen in enumerate(map_gens):
+                while not done[mi]:
+                    try:
+                        ref = gen.try_next()
+                    except StopIteration:
+                        done[mi] = True
+                        if len(parts[mi]) < P:
+                            raise RuntimeError(
+                                f"shuffle map {mi} of {self.name!r} "
+                                f"yielded {len(parts[mi])} partitions, "
+                                f"expected {P}")
+                        break
+                    if ref is None:
+                        break
+                    parts[mi].append(ref)
+                    progressed = True
+            while next_p < P and all(len(b) > next_p for b in parts):
+                reduce_refs.append(_reduce.remote(
+                    next_p, *[b[next_p] for b in parts]))
+                next_p += 1
+                progressed = True
+            if next_p < P and not progressed:
+                pending = [r for mi, gen in enumerate(map_gens)
+                           if not done[mi] for r in gen.wait_refs()]
+                if pending:
+                    ray_tpu.wait(pending, num_returns=1, timeout=1.0)
+        for mi, gen in enumerate(map_gens):
+            if not done[mi]:  # settle the end markers (errors re-raise)
+                for _ in gen:
+                    pass
         for rref in reduce_refs:  # partition order IS output order
             for ref, n in ray_tpu.get(rref):
                 rows += n
@@ -429,14 +503,20 @@ def stream_plan(operators: List[Operator], *, fuse: bool = True,
             if isinstance(op, LimitOperator):
                 progress |= _pump_limit(i, s)
                 continue
-            # Harvest head-of-line completions (order preservation).
+            # Harvest head-of-line streams (order preservation): the head
+            # task's committed yields flow downstream IMMEDIATELY — block
+            # 0 dispatches into operator i+1 while the producing task is
+            # still emitting block 1. Later tasks' streams buffer in their
+            # generators until the head finishes (order contract).
             while s.inflight:
                 head = s.inflight[0]
-                ready, _ = ray_tpu.wait([head], num_returns=1, timeout=0)
-                if not ready:
+                got, exhausted = _drain_stream(head)
+                if got:
+                    _push_down(i, got)
+                    progress = True
+                if not exhausted:
                     break
                 s.inflight.popleft()
-                _push_down(i, s.op.harvest(head))
                 progress = True
             # Dispatch while input + budget + downstream headroom exist.
             # The queue cap only applies when downstream consumes
@@ -532,9 +612,17 @@ def stream_plan(operators: List[Operator], *, fuse: bool = True,
             if not _pump_once() and not out:
                 # Nothing completed and nothing dispatchable: block
                 # briefly on ANY in-flight task instead of spinning.
-                inflight = [r for s in st for r in s.inflight]
-                if inflight:
-                    ray_tpu.wait(inflight, num_returns=1, timeout=0.1)
+                # Streaming map/read tasks contribute their next-item +
+                # end-marker refs, so a mid-task yield wakes the loop.
+                refs = []
+                for s in st:
+                    for h in s.inflight:
+                        if isinstance(h, ray_tpu.ObjectRefGenerator):
+                            refs.extend(h.wait_refs())
+                        else:
+                            refs.append(h)
+                if refs:
+                    ray_tpu.wait(refs, num_returns=1, timeout=0.1)
     finally:
         _stats.total_wall_s = time.perf_counter() - t_start
 

@@ -57,13 +57,27 @@ class RemoteFunction:
         worker = auto_init()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if not streaming and not isinstance(num_returns, int):
+            raise ValueError(
+                f'num_returns must be an int or "streaming", '
+                f'got {num_returns!r}')
         task_id = worker.next_task_id()
-        # num_returns=0 still gets one hidden completion marker object so
-        # dependents/lineage/ref-release have something to hang off.
-        return_ids = [
-            ObjectID.for_task_return(task_id, i)
-            for i in range(max(num_returns, 1))
-        ]
+        if streaming:
+            # Streaming generator: item refs materialize dynamically as
+            # the task yields; the only statically-declared return is the
+            # END MARKER object (total yield count / task error), which
+            # rides the whole existing completion machinery.
+            from ray_tpu._private.streaming import stream_end_id
+
+            return_ids = [stream_end_id(task_id)]
+        else:
+            # num_returns=0 still gets one hidden completion marker object
+            # so dependents/lineage/ref-release have something to hang off.
+            return_ids = [
+                ObjectID.for_task_return(task_id, i)
+                for i in range(max(num_returns, 1))
+            ]
         max_retries = opts.get("max_retries")
         if max_retries is None:
             max_retries = GlobalConfig.task_max_retries
@@ -72,7 +86,7 @@ class RemoteFunction:
             function=self._function,
             args=args,
             kwargs=kwargs,
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
             return_ids=return_ids,
             name=opts.get("name") or getattr(
                 self._function, "__name__", "task"),
@@ -81,8 +95,15 @@ class RemoteFunction:
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=_coerce_env(opts.get("runtime_env")),
+            streaming=streaming,
+            backpressure=(GlobalConfig.generator_backpressure_items
+                          if streaming else 0),
         )
         refs = worker.submit_task(spec)
+        if streaming:
+            from ray_tpu._private.worker import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id, worker)
         if num_returns == 0:
             return None
         return refs[0] if num_returns == 1 else refs

@@ -139,11 +139,32 @@ class ServeController:
                         f"callable")
                 return fn(*args, **kwargs)
 
+            def handle_stream_gen(self, method, args, kwargs):
+                """Generator method on the streaming task plane: invoked
+                with ``num_returns="streaming"``, so every yield commits
+                one item ref the caller's ObjectRefGenerator consumes
+                incrementally — the handle's ``next()`` unblocks on THIS
+                replica's next yield, and the yield loop pauses at the
+                backpressure budget when the consumer lags."""
+                args = tuple(
+                    ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef)
+                    else a for a in args)
+                kwargs = {
+                    k: (ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef)
+                        else v)
+                    for k, v in kwargs.items()
+                }
+                fn = (self._user if method == "__call__"
+                      else getattr(self._user, method))
+                yield from fn(*args, **kwargs)
+
             def handle_stream(self, method, args, kwargs, stream_id):
-                """Generator method: items stream through the driver KV
-                under (stream_id, seq) keys — the response generator on
-                the caller side polls them in order (chunked-response
-                parity; works from thread or process replicas alike)."""
+                """THIN-CLIENT FALLBACK: items stream through the driver
+                KV under (stream_id, seq) keys — the response generator on
+                the caller side polls them in order. Kept for handles that
+                crossed a process boundary (detached) or replica runtimes
+                without the streaming actor plane; the primary path is
+                ``handle_stream_gen`` above."""
                 import pickle as _pickle
 
                 from ray_tpu._private.worker import auto_init

@@ -53,7 +53,64 @@ class DeploymentResponse:
 class DeploymentResponseGenerator:
     """Streaming response: iterate items as the replica's generator yields
     them (reference: handle.options(stream=True) generator semantics).
-    Items arrive through the driver KV under (stream_id, seq) keys."""
+    Backed by the streaming task plane — the replica's ``handle_stream_gen``
+    runs with ``num_returns="streaming"`` and each yield commits an item
+    ref the ``ObjectRefGenerator`` hands out incrementally, so ``next()``
+    unblocks on the replica's NEXT yield (no KV polling, and the producer
+    honors the ``RAY_TPU_GENERATOR_BACKPRESSURE_ITEMS`` budget against
+    this consumer). ``close()`` — or dropping the generator — cancels the
+    in-flight replica generator between yields."""
+
+    def __init__(self, ref_gen, replica_set, replica_key, replica=None):
+        self._gen = ref_gen  # ObjectRefGenerator
+        self._rs = replica_set
+        self._key = replica_key
+        self._replica = replica  # strong ref; see DeploymentResponse
+        self._released = False
+        self._lock = threading.Lock()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+        except BaseException:  # noqa: BLE001 — incl. StopIteration
+            self._release()
+            raise
+        return ray_tpu.get(ref)
+
+    def close(self):
+        """Stop consuming: cancels the replica's in-flight generator and
+        releases committed-but-unconsumed items."""
+        try:
+            self._gen.close()
+        finally:
+            self._release()
+
+    def _release(self):
+        with self._lock:
+            if not self._released:
+                self._released = True
+                self._rs.release(self._key)
+                self._replica = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter-teardown safety
+            pass
+
+
+class _KVStreamFallbackGenerator:
+    """THIN-CLIENT FALLBACK stream: items arrive through the driver KV
+    under (stream_id, seq) keys, polled in order. Used only where the
+    streaming actor plane is unavailable — a handle that crossed a
+    process boundary (detached/pickled into a replica) or a replica
+    hosted by a runtime without generator-method support. The primary
+    path is ``DeploymentResponseGenerator`` over ``ObjectRefGenerator``;
+    this poller trades latency (2 ms poll cadence, no backpressure) for
+    working over nothing but the KV."""
 
     def __init__(self, ref, replica_set, replica_key, stream_id: str):
         self._inner = DeploymentResponse(ref, replica_set, replica_key)
@@ -168,12 +225,28 @@ class DeploymentHandle:
         }
         self._controller._record_request(self._name)
         if self._stream:
+            try:
+                # Primary: the streaming task plane — the replica's
+                # generator yields straight into item refs this driver
+                # consumes incrementally (with backpressure).
+                ref_gen = replica.handle_stream_gen.options(
+                    num_returns="streaming").remote(
+                        self._method, args, kwargs)
+                return DeploymentResponseGenerator(
+                    ref_gen, rs, key, replica=replica)
+            except (ValueError, AttributeError, TypeError):
+                # Thin-client mode: the replica's runtime has no
+                # streaming plane (cluster-placed / detached handle) —
+                # fall back to (stream_id, seq) KV polling. TypeError is
+                # the client-path signature: _ActorRuntime.submit hits
+                # range("streaming") server-side.
+                pass
             import uuid
 
             stream_id = uuid.uuid4().hex
             ref = replica.handle_stream.remote(
                 self._method, args, kwargs, stream_id)
-            return DeploymentResponseGenerator(ref, rs, key, stream_id)
+            return _KVStreamFallbackGenerator(ref, rs, key, stream_id)
         method = getattr(replica, "handle_request")
         ref = method.remote(self._method, args, kwargs)
         resp = DeploymentResponse(ref, rs, key, replica=replica)

@@ -14,12 +14,12 @@ metrics are any numeric leaf whose key ends in "_per_sec" or equals
 direction (higher is worse). With fewer than two records the gate
 passes trivially (nothing to regress against).
 
-REQUIRED metrics (--require, default: the cluster fan-out headline)
-gate harder: each must be PRESENT in the newest record (a skipped
-cluster spin-up cannot silently pass), and is compared against the
-most recent PRIOR record that carries it — so a record from a PR that
-benched a different plane in between cannot mask a cross-node
-regression.
+REQUIRED metrics (--require, default: the cluster fan-out headline +
+the streaming-generator sustained-throughput headline) gate harder:
+each must be PRESENT in the newest record (a skipped cluster spin-up
+cannot silently pass), and is compared against the most recent PRIOR
+record that carries it — so a record from a PR that benched a
+different plane in between cannot mask a cross-node regression.
 
 Wired as ``make bench-gate``.
 """
@@ -85,7 +85,8 @@ def _record_order(path: str) -> tuple:
     return (int(m.group(1)) if m else -1, path)
 
 
-DEFAULT_REQUIRED = "cluster_fanout_1k.tasks_per_sec"
+DEFAULT_REQUIRED = ("cluster_fanout_1k.tasks_per_sec,"
+                    "streaming.backpressured_items_per_sec")
 
 
 def check_required(paths: list, curr: dict, threshold: float,

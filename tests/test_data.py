@@ -292,6 +292,47 @@ def test_streaming_op2_starts_before_op1_finishes(tmp_path):
         f"last read at {read_ends[-1]}")
 
 
+def test_first_block_available_before_producer_completes(tmp_path):
+    """Streaming-generator block emission (num_returns="streaming"): ONE
+    read task producing several blocks must make block 0 consumable at
+    the sink strictly BEFORE the producing task itself finishes — the
+    property the old num_returns=P protocol could not provide (its
+    metadata list returned only at task completion)."""
+    import time as _time
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, worker_mode="thread",
+                 ignore_reinit_error=True)
+
+    from ray_tpu.data.executor import InputOperator, stream_plan
+
+    stamp_dir = str(tmp_path)
+
+    def slow_read():
+        # A generator read task: blocks trickle out 0.1 s apart and the
+        # end stamp is written only after the last block was emitted.
+        for i in range(5):
+            _time.sleep(0.1)
+            yield {"x": np.full(2, i)}
+        with open(f"{stamp_dir}/task.end", "w") as f:
+            f.write(str(_time.time()))
+
+    gen = stream_plan([InputOperator("read", [slow_read],
+                                     max_in_flight=1)], fuse=False)
+    ref, rows = next(gen)
+    t_first = _time.time()
+    assert rows == 2
+    assert not os.path.exists(f"{stamp_dir}/task.end"), (
+        "first block only became consumable after the producer task "
+        "completed — streaming emission is not incremental")
+    rest = list(gen)
+    assert len(rest) == 4
+    t_end = float(open(f"{stamp_dir}/task.end").read())
+    assert t_first < t_end
+    vals = [ray_tpu.get(r)["x"][0] for r, _ in [(ref, rows)] + rest]
+    assert vals == [0, 1, 2, 3, 4]
+
+
 def test_iter_batches_streams_without_materializing(ray_start_regular,
                                                     tmp_path):
     """iter_batches pulls through the pipeline: the first batch arrives
@@ -406,3 +447,22 @@ def test_logical_limit_pushdown_and_merge(ray_start_regular):
     assert [o.name for o in limit_pushdown_rule([flat, lim])] == [
         "FlatMap", "Limit[3]"]
     assert limit_merge_rule([lim, lim])[0].limit == 3
+
+
+def test_push_shuffle_backpressure_more_maps_than_slots(ray_start_regular):
+    """Regression: with a backpressure budget < P and more shuffle maps
+    than worker slots, the harvest loop must drain whichever map has
+    committed parts — a strict lockstep next() round-robin deadlocks
+    (scheduled maps park at the budget holding every slot while the
+    driver awaits a still-queued map's first yield)."""
+    import ray_tpu.data as rd
+    from ray_tpu._private.config import GlobalConfig
+
+    old = GlobalConfig.generator_backpressure_items
+    GlobalConfig.generator_backpressure_items = 2
+    try:
+        ds = rd.range(64, parallelism=6).random_shuffle(seed=0)
+        rows = sorted(r["id"] for r in ds.take_all())
+    finally:
+        GlobalConfig.generator_backpressure_items = old
+    assert rows == list(range(64))

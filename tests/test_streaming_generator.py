@@ -1,0 +1,553 @@
+"""Streaming-generator task plane tests (reference test model:
+python/ray/tests/test_streaming_generator.py — num_returns="streaming"
+returning an ObjectRefGenerator whose item refs materialize per yield,
+consumer-driven backpressure, cancellation, and mid-stream failure
+semantics incl. kill -9 of the producing worker)."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayTaskError, TaskCancelledError
+
+
+@pytest.fixture
+def thread_runtime():
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=2, worker_mode="thread",
+                          ignore_reinit_error=True)
+    yield worker
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def proc_runtime():
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=2, worker_mode="process",
+                          ignore_reinit_error=True)
+    if worker.worker_pool is None:
+        pytest.skip("native layer unavailable: no process plane")
+    yield worker
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def backpressure_4():
+    from ray_tpu._private.config import GlobalConfig
+
+    old = GlobalConfig.generator_backpressure_items
+    GlobalConfig.generator_backpressure_items = 4
+    yield 4
+    GlobalConfig.generator_backpressure_items = old
+
+
+# ---------------------------------------------------------------- basics
+def test_streaming_returns_object_ref_generator(thread_runtime):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.options(num_returns="streaming").remote(5)
+    assert isinstance(g, ray_tpu.ObjectRefGenerator)
+    refs = list(g)
+    assert all(isinstance(r, ray_tpu.ObjectRef) for r in refs)
+    assert [ray_tpu.get(r) for r in refs] == [0, 10, 20, 30, 40]
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_completed_ref_carries_total_count(thread_runtime):
+    @ray_tpu.remote
+    def gen():
+        yield "a"
+        yield "b"
+
+    g = gen.options(num_returns="streaming").remote()
+    done = g.completed()
+    assert ray_tpu.get(done, timeout=10) == 2  # total yield count
+    assert [ray_tpu.get(r) for r in g] == ["a", "b"]
+
+
+def test_invalid_num_returns_rejected(thread_runtime):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="streaming"):
+        f.options(num_returns="dynamic").remote()
+
+
+def test_non_generator_function_fails_typed(thread_runtime):
+    @ray_tpu.remote
+    def not_a_gen():
+        return 42
+
+    g = not_a_gen.options(num_returns="streaming").remote()
+    with pytest.raises(TypeError, match="non-iterable"):
+        next(g)
+
+
+def test_first_item_before_task_completion(thread_runtime):
+    """The headline property: next() unblocks on the FIRST yield, not on
+    task completion."""
+
+    @ray_tpu.remote
+    def gen(n, delay):
+        for i in range(n):
+            time.sleep(delay)
+            yield i
+
+    t0 = time.monotonic()
+    g = gen.options(num_returns="streaming").remote(20, 0.03)
+    first = ray_tpu.get(next(g))
+    t_first = time.monotonic() - t0
+    assert first == 0
+    rest = [ray_tpu.get(r) for r in g]
+    t_all = time.monotonic() - t0
+    assert rest == list(range(1, 20))
+    assert t_first < t_all / 3, (
+        f"first item at {t_first:.3f}s vs stream end {t_all:.3f}s — "
+        f"delivery is not incremental")
+
+
+def test_try_next_is_nonblocking(thread_runtime):
+    release = threading.Event()
+    step = threading.Event()
+
+    @ray_tpu.remote
+    def gen():
+        yield 1
+        step.set()
+        release.wait(10)
+        yield 2
+
+    g = gen.options(num_returns="streaming").remote()
+    assert step.wait(10)
+    assert ray_tpu.get(g.try_next()) == 1
+    assert g.try_next() is None  # second yield is blocked on the event
+    release.set()
+    assert ray_tpu.get(next(g)) == 2
+    with pytest.raises(StopIteration):
+        g.try_next()
+
+
+def test_generator_items_feed_downstream_tasks(thread_runtime):
+    """Item refs are ordinary ObjectRefs: passing one to another task
+    resolves the yielded value."""
+
+    @ray_tpu.remote
+    def gen():
+        for i in range(3):
+            yield i + 1
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    out = [ray_tpu.get(double.remote(r))
+           for r in gen.options(num_returns="streaming").remote()]
+    assert out == [2, 4, 6]
+
+
+# ----------------------------------------------------------- backpressure
+def test_backpressure_budget_never_exceeded(proc_runtime, backpressure_4):
+    """Acceptance criterion: with RAY_TPU_GENERATOR_BACKPRESSURE_ITEMS=4
+    the producer's committed-but-unconsumed item count never exceeds the
+    budget — asserted by the stream's peak_unconsumed counter."""
+
+    @ray_tpu.remote
+    def fast_gen(n):
+        for i in range(n):
+            yield i
+
+    g = fast_gen.options(num_returns="streaming").remote(40)
+    stream = proc_runtime.streams.get(g.task_id)
+    vals = []
+    for r in g:  # deliberately slow consumer: the producer must pause
+        time.sleep(0.005)
+        vals.append(ray_tpu.get(r))
+    assert vals == list(range(40))
+    assert stream.peak_unconsumed <= 4, (
+        f"producer committed {stream.peak_unconsumed} unconsumed items "
+        f"past the budget of 4")
+
+
+def test_backpressure_pauses_producer_thread_plane(thread_runtime,
+                                                   backpressure_4):
+    """The yield loop itself pauses: with a stalled consumer the
+    producer-side committed count parks at the budget."""
+
+    @ray_tpu.remote
+    def fast_gen(n):
+        for i in range(n):
+            yield i
+
+    g = fast_gen.options(num_returns="streaming").remote(100)
+    stream = thread_runtime.streams.get(g.task_id)
+    deadline = time.monotonic() + 10
+    while stream.committed < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.2)  # would overshoot here if the pause protocol failed
+    assert stream.committed == 4
+    assert stream.paused_events >= 1
+    assert [ray_tpu.get(r) for r in g] == list(range(100))
+    assert stream.peak_unconsumed <= 4
+
+
+# ----------------------------------------------------------- cancellation
+def test_close_cancels_inflight_producer(proc_runtime, tmp_path):
+    """Dropping the generator cancels the producing task between yields:
+    the yield counter stops advancing."""
+    marker = str(tmp_path / "yields.log")
+
+    @ray_tpu.remote
+    def slow_gen():
+        for i in range(1000):
+            with open(marker, "a") as f:
+                f.write(f"{i}\n")
+            time.sleep(0.01)
+            yield i
+
+    g = slow_gen.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g)) == 0
+    g.close()
+    time.sleep(0.5)  # let any in-flight yield settle
+    with open(marker) as f:
+        count_after_close = len(f.readlines())
+    time.sleep(0.5)
+    with open(marker) as f:
+        count_later = len(f.readlines())
+    assert count_later == count_after_close, (
+        "producer kept yielding after close()")
+    assert count_later < 1000
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_close_releases_unconsumed_items(thread_runtime):
+    """Committed-but-unconsumed item payloads are freed on close()."""
+
+    @ray_tpu.remote
+    def gen():
+        for i in range(4):
+            yield bytes(100_000)
+
+    g = gen.options(num_returns="streaming").remote()
+    ray_tpu.get(g.completed(), timeout=10)  # all 4 committed, 0 consumed
+    from ray_tpu._private.streaming import stream_item_id
+    from ray_tpu.exceptions import ObjectLostError
+
+    tid = g.task_id
+    store = thread_runtime.store
+    mem_before = store._memory_used
+    assert store.is_ready(stream_item_id(tid, 1))
+    g.close()
+    # The payload bytes are released (a typed tombstone remains).
+    assert store._memory_used <= mem_before - 4 * 90_000
+    with pytest.raises(ObjectLostError, match="freed"):
+        ray_tpu.get(ray_tpu.ObjectRef(stream_item_id(tid, 1),
+                                      _add_ref=False))
+
+
+def test_generator_gc_cancels(proc_runtime):
+    """Letting the generator go out of scope behaves like close()."""
+
+    @ray_tpu.remote
+    def slow_gen():
+        for i in range(1000):
+            time.sleep(0.01)
+            yield i
+
+    g = slow_gen.options(num_returns="streaming").remote()
+    tid = g.task_id
+    assert ray_tpu.get(next(g)) == 0
+    del g
+    deadline = time.monotonic() + 10
+    while proc_runtime.streams.get(tid) is not None:
+        assert time.monotonic() < deadline, "stream state leaked after GC"
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------- failure paths
+def test_midstream_error_surfaces_at_next(thread_runtime):
+    @ray_tpu.remote
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("stream boom")
+
+    g = bad_gen.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    with pytest.raises(ValueError, match="stream boom"):
+        next(g)
+    # Terminal: the generator stays closed.
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_kill9_worker_midstream_typed_error(proc_runtime):
+    """kill -9 the producing worker after K yields: the next next() gets
+    a typed error (max_retries=0 — no replay)."""
+
+    @ray_tpu.remote(max_retries=0)
+    def gen():
+        yield os.getpid()
+        for i in range(1, 1000):
+            time.sleep(0.01)
+            yield i
+
+    g = gen.options(num_returns="streaming").remote()
+    pid = ray_tpu.get(next(g))
+    consumed = [ray_tpu.get(next(g)) for _ in range(3)]  # K = 4 total
+    assert consumed == [1, 2, 3]
+    os.kill(pid, signal.SIGKILL)
+    with pytest.raises(RayTaskError, match="died mid-stream"):
+        for _ in range(1000):
+            next(g)
+
+
+def test_kill9_worker_midstream_lineage_replay_dedup(proc_runtime,
+                                                     tmp_path):
+    """kill -9 after K yields with retries: lineage re-execution replays
+    the deterministic generator from yield 0, and the consumer sees every
+    index EXACTLY once (already-consumed indices < K are deduped by the
+    watermark — they re-commit idempotently but are never re-delivered)."""
+    marker = str(tmp_path / "attempts.log")
+    kill_file = str(tmp_path / "kill")
+
+    @ray_tpu.remote(max_retries=1)
+    def gen(n):
+        with open(marker, "a") as f:
+            f.write(f"{os.getpid()}\n")
+        for i in range(n):
+            # First attempt dies mid-stream at i == 6 (after 6 yields);
+            # the replay finds the tombstone consumed and streams clean.
+            if i == 6 and not os.path.exists(kill_file):
+                open(kill_file, "w").close()
+                os.kill(os.getpid(), signal.SIGKILL)
+            yield i
+            time.sleep(0.005)
+
+    g = gen.options(num_returns="streaming").remote(10)
+    consumed = [ray_tpu.get(r) for r in g]
+    assert consumed == list(range(10)), (
+        f"duplicate or missing indices after replay: {consumed}")
+    with open(marker) as f:
+        attempts = f.read().splitlines()
+    assert len(attempts) == 2, f"expected 2 attempts, saw {len(attempts)}"
+
+
+def test_retries_exhausted_typed_error_after_replay(proc_runtime,
+                                                    tmp_path):
+    """Every attempt dies: after max_retries replays the typed error
+    lands at next()."""
+    marker = str(tmp_path / "attempts.log")
+
+    @ray_tpu.remote(max_retries=1)
+    def gen():
+        with open(marker, "a") as f:
+            f.write("attempt\n")
+        yield 0
+        yield 1
+        time.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    g = gen.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g)) == 0
+    assert ray_tpu.get(next(g)) == 1
+    with pytest.raises(RayTaskError, match="died mid-stream"):
+        for _ in range(1000):
+            next(g)
+    with open(marker) as f:
+        assert len(f.read().splitlines()) == 2  # original + 1 replay
+
+
+# ------------------------------------------------------------ actor plane
+def test_actor_generator_methods_all_flavors(proc_runtime):
+    @ray_tpu.remote
+    class SyncActor:  # non-mux process actor
+        def gen(self, n):
+            for i in range(n):
+                yield i * 2
+
+    @ray_tpu.remote(max_concurrency=4)
+    class MuxActor:  # multiplexed process actor
+        def gen(self, n):
+            for i in range(n):
+                yield i * 3
+
+        async def agen(self, n):
+            for i in range(n):
+                yield i * 5
+
+    a = SyncActor.remote()
+    assert [ray_tpu.get(r) for r in
+            a.gen.options(num_returns="streaming").remote(4)] == [0, 2, 4, 6]
+    m = MuxActor.remote()
+    assert [ray_tpu.get(r) for r in
+            m.gen.options(num_returns="streaming").remote(4)] == [0, 3, 6, 9]
+    assert [ray_tpu.get(r) for r in
+            m.agen.options(num_returns="streaming").remote(3)] == [0, 5, 10]
+
+
+def test_actor_generator_backpressure(proc_runtime, backpressure_4):
+    @ray_tpu.remote
+    class A:
+        def gen(self, n):
+            for i in range(n):
+                yield i
+
+    a = A.remote()
+    g = a.gen.options(num_returns="streaming").remote(30)
+    stream = proc_runtime.streams.get(g.task_id)
+    vals = []
+    for r in g:
+        time.sleep(0.005)
+        vals.append(ray_tpu.get(r))
+    assert vals == list(range(30))
+    assert stream.peak_unconsumed <= 4
+
+
+# ------------------------------------------------------------ cluster plane
+pytestmark_cluster = pytest.mark.slow
+
+
+@pytest.mark.slow
+class TestClusterStreaming:
+    """Real head + node daemon processes: item_done over the direct
+    plane, backpressure acks across the wire, node-death replay."""
+
+    @pytest.fixture
+    def cluster(self, tmp_path):
+        from tests.test_multinode import _spawn_head, _spawn_node
+
+        ray_tpu.shutdown()
+        os.environ["RAY_TPU_HEAD_CLIENT_TIMEOUT_S"] = "2.0"
+        head, address = _spawn_head(tmp_path)
+        node1 = node2 = None
+        try:
+            node1 = _spawn_node(address, 1, '{"n1": 1}', "thread")
+            node2 = _spawn_node(address, 1, '{"n2": 1}', "thread")
+            ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                         address=address)
+            yield {"address": address, "head": head,
+                   "node1": node1, "node2": node2}
+        finally:
+            ray_tpu.shutdown()
+            for p in (node1, node2, head):
+                if p is not None:
+                    p.kill()
+                    p.wait(timeout=5)
+            os.environ.pop("RAY_TPU_HEAD_CLIENT_TIMEOUT_S", None)
+
+    def test_remote_stream_incremental_delivery(self, cluster):
+        @ray_tpu.remote
+        def gen(n, delay):
+            for i in range(n):
+                time.sleep(delay)
+                yield i
+
+        t0 = time.monotonic()
+        g = gen.options(num_returns="streaming").remote(10, 0.05)
+        first = ray_tpu.get(next(g), timeout=30)
+        t_first = time.monotonic() - t0
+        rest = [ray_tpu.get(r, timeout=30) for r in g]
+        t_all = time.monotonic() - t0
+        assert first == 0 and rest == list(range(1, 10))
+        assert t_first < t_all / 2
+
+    def test_remote_stream_large_items_pull(self, cluster):
+        @ray_tpu.remote
+        def big_gen():
+            for i in range(3):
+                yield bytes([i]) * 300_000  # above inline_object_max_bytes
+
+        g = big_gen.options(num_returns="streaming").remote()
+        vals = [ray_tpu.get(r, timeout=60) for r in g]
+        assert [len(v) for v in vals] == [300_000] * 3
+        assert [v[:1] for v in vals] == [b"\x00", b"\x01", b"\x02"]
+
+    def test_remote_backpressure_over_the_wire(self, cluster):
+        from ray_tpu._private.config import GlobalConfig
+
+        old = GlobalConfig.generator_backpressure_items
+        GlobalConfig.generator_backpressure_items = 4
+        try:
+            @ray_tpu.remote
+            def fast_gen(n):
+                for i in range(n):
+                    yield i
+
+            g = fast_gen.options(num_returns="streaming").remote(30)
+            w = ray_tpu._private.worker.global_worker()
+            stream = w.streams.get(g.task_id)
+            vals = []
+            for r in g:
+                time.sleep(0.01)
+                vals.append(ray_tpu.get(r, timeout=30))
+            assert vals == list(range(30))
+            # The driver-side stream sees the producer's commits: the
+            # committed-ahead-of-consumed watermark stays within budget
+            # (+1 frame slack for an item_done already on the wire when
+            # the ack landed).
+            assert stream.peak_unconsumed <= 5, stream.peak_unconsumed
+        finally:
+            GlobalConfig.generator_backpressure_items = old
+
+    def test_node_daemon_kill_midstream_replays_and_dedupes(self, cluster,
+                                                            tmp_path):
+        """kill -9 the node daemon hosting the producer after K yields:
+        the watch loop reroutes the task, the replayed generator
+        re-commits indices < K idempotently, and the consumer sees every
+        index exactly once."""
+
+        @ray_tpu.remote
+        def gen(n):
+            yield os.getpid()
+            for i in range(1, n):
+                time.sleep(0.05)
+                yield i
+
+        g = gen.options(num_returns="streaming").remote(40)
+        producer_pid = ray_tpu.get(next(g), timeout=30)
+        consumed = [producer_pid]
+        for _ in range(3):  # K = 4 consumed before the kill
+            consumed.append(ray_tpu.get(next(g), timeout=30))
+        assert consumed[1:] == [1, 2, 3]
+        victim = ("node1" if cluster["node1"].pid == producer_pid
+                  else "node2")
+        cluster[victim].kill()
+        cluster[victim].wait(timeout=5)
+        rest = [ray_tpu.get(r, timeout=120) for r in g]
+        # The replay re-yields its (new) pid at index 0, but index 0 was
+        # already consumed: no duplicate delivery, and indices 4..39
+        # arrive exactly once, in order.
+        assert rest == list(range(4, 40)), rest
+
+    def test_node_daemon_kill_no_retry_typed_error(self, cluster):
+        """Producer node dies and the task has max_retries=0: typed
+        error at the next next()."""
+
+        @ray_tpu.remote(max_retries=0)
+        def gen(n):
+            yield os.getpid()
+            for i in range(1, n):
+                time.sleep(0.05)
+                yield i
+
+        g = gen.options(num_returns="streaming").remote(100)
+        producer_pid = ray_tpu.get(next(g), timeout=30)
+        victim = ("node1" if cluster["node1"].pid == producer_pid
+                  else "node2")
+        cluster[victim].kill()
+        cluster[victim].wait(timeout=5)
+        with pytest.raises(Exception) as exc_info:
+            for _ in range(1000):
+                next(g)
+        assert not isinstance(exc_info.value, StopIteration)
