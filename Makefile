@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: test bench bench-control-plane bench-gate
+.PHONY: test bench bench-control-plane bench-llm bench-gate
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -14,7 +14,13 @@ bench:
 bench-control-plane:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite control_plane
 
+# Continuous-batching LLM serving: tokens/s vs naive per-request decode
+# plus time-to-first-token on the streamed path. Prints one JSON line.
+bench-llm:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite llm_serving
+
 # Regression gate over committed BENCH_pr*.json records: fails when the
-# newest record regresses >20% vs the previous one.
+# newest record regresses >20% vs the previous one; required headline
+# metrics (cluster fan-out, streaming, llm_serving) must be present.
 bench-gate:
 	$(PYTHON) scripts/check_bench.py

@@ -1,0 +1,31 @@
+"""LLM inference library (L11): continuous-batching token serving over
+the flagship transformer (reference roles: Ray Serve LLM + vLLM's
+engine — Orca iteration-level batching, PagedAttention KV management).
+
+- ``PagedKVCache`` (kv_cache.py): fixed-size blocks in preallocated
+  device arrays, per-sequence block tables, immediate free/reuse.
+- ``Scheduler`` (scheduler.py): bounded-waitqueue admission, prefill
+  token budget, recompute eviction on KV OOM.
+- ``InferenceEngine`` (engine.py): jitted prefill/decode step loop with
+  streaming per-request token queues.
+- ``build_llm_app`` (api.py): Serve deployment builder — token streams
+  ride ``handle.options(stream=True)`` / chunked HTTP with per-request
+  cancellation propagating to sequence-free.
+"""
+
+from ray_tpu.llm.api import LLMServer, build_llm_app
+from ray_tpu.llm.engine import EngineConfig, InferenceEngine
+from ray_tpu.llm.kv_cache import KVCacheOOM, PagedKVCache
+from ray_tpu.llm.scheduler import EngineQueueFull, Request, Scheduler
+
+__all__ = [
+    "EngineConfig",
+    "EngineQueueFull",
+    "InferenceEngine",
+    "KVCacheOOM",
+    "LLMServer",
+    "PagedKVCache",
+    "Request",
+    "Scheduler",
+    "build_llm_app",
+]

@@ -1,0 +1,83 @@
+"""Serve-facing LLM API (reference role: Ray Serve LLM's
+``build_openai_app``/LLMServer — a deployment builder that wraps the
+inference engine in a streaming Serve deployment).
+
+``build_llm_app(EngineConfig(...))`` returns a Serve Application whose
+replicas each own one ``InferenceEngine``. Requests stream: the replica
+handler is a generator, so ``handle.options(stream=True)`` (and the
+HTTP proxy's ``?stream=1`` chunked path) deliver each token as the
+engine's iteration commits it, with first-token latency of one prefill.
+Closing the stream client-side cancels the replica generator between
+yields (the streaming task plane's contract), which unwinds into the
+engine as ``GeneratorExit`` and frees the sequence's KV blocks
+immediately.
+
+Autoscaling: an open token stream counts as one ongoing request on its
+replica until exhausted or closed (serve router accounting), so a
+deployment built with ``autoscaling_config=`` scales up under
+streaming-heavy load; ``queue_depth()`` additionally exposes the
+engine's parked-admission depth per replica for dashboards/policies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Union
+
+from ray_tpu.llm.engine import EngineConfig, InferenceEngine
+
+__all__ = ["build_llm_app", "LLMServer"]
+
+
+class LLMServer:
+    """Replica class: one engine, streaming ``__call__``.
+
+    A request is either a token list (``[1, 2, 3]``) or a dict
+    ``{"prompt": [...], "max_new_tokens": n, "temperature": t,
+    "eos_token_id": e, "seed": s}``. Yields one int token id per
+    generated token.
+    """
+
+    def __init__(self, engine_config: Optional[EngineConfig] = None,
+                 params: Optional[dict] = None):
+        self.engine = InferenceEngine(engine_config, params=params)
+
+    def __call__(self, request: Union[Dict[str, Any], list]
+                 ) -> Iterator[int]:
+        if isinstance(request, dict):
+            prompt = request["prompt"]
+            kwargs = {k: request[k] for k in
+                      ("max_new_tokens", "eos_token_id", "temperature",
+                       "seed") if k in request}
+        else:
+            prompt, kwargs = request, {}
+        # A cancelled stream raises GeneratorExit through here; the
+        # engine generator's finally-cancel frees the KV blocks.
+        yield from self.engine.generate([int(t) for t in prompt], **kwargs)
+
+    # ------------------------------------------------- replica telemetry
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth()
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+def build_llm_app(engine_config: Optional[EngineConfig] = None, *,
+                  name: str = "llm", num_replicas: int = 1,
+                  autoscaling_config: Optional[dict] = None,
+                  params: Optional[dict] = None):
+    """Build a Serve Application serving ``engine_config``.
+
+    Every replica constructs its own engine; with ``params=None`` the
+    weights init from ``engine_config.param_seed`` in-replica, so all
+    replicas serve identical weights without shipping arrays through
+    the deployment args. Deploy with ``serve.run(app)`` and stream via
+    ``handle.options(stream=True).remote({...})`` or
+    ``POST /<name>?stream=1``.
+    """
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        autoscaling_config=autoscaling_config)
+    return dep.bind(engine_config, params)

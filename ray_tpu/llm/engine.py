@@ -1,0 +1,408 @@
+"""Continuous-batching inference engine over the flagship Transformer
+(reference role: vLLM's LLMEngine / Ray Serve LLM's engine actor).
+
+One ``InferenceEngine`` owns a paged KV cache pool, a continuous-
+batching scheduler, and two jitted programs over ``models.transformer``:
+
+- ``prefill_with_cache``: admitted prompts, padded to a (batch, seq)
+  bucket, write their K/V into their allocated blocks in one program
+  and produce each request's FIRST generated token;
+- ``decode_step``: every running sequence advances one token per
+  iteration in one program — Orca's iteration-level batching, so a new
+  request joins the batch at the next step boundary instead of waiting
+  for the batch to drain, and a finished sequence leaves it (and frees
+  its blocks) immediately.
+
+Padding buckets are powers of two, so the number of distinct compiled
+programs is logarithmic in the caps. Padded rows aim at the NULL block
+and their logits are ignored; because attention masks every slot past a
+sequence's context length, a sequence's tokens are IDENTICAL whatever
+batch it happened to share an iteration with — the engine's
+concurrent-equals-sequential parity test pins exactly that.
+
+Requests stream: ``generate()`` yields token ids as iterations commit
+them (time-to-first-token ≈ one prefill, not a full completion), and
+closing the consumer (``GeneratorExit``) cancels the sequence — its
+blocks return to the pool immediately, unblocking parked admissions.
+The engine is thread-safe; a Serve replica drives it from concurrent
+streaming handlers with no extra locking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.llm.kv_cache import KVCacheOOM, PagedKVCache  # noqa: F401
+from ray_tpu.llm.scheduler import (
+    CANCELLED,
+    FAILED,
+    FINISHED,
+    EngineQueueFull,
+    Request,
+    Scheduler,
+)
+
+__all__ = ["EngineConfig", "InferenceEngine"]
+
+_DONE = "__done__"
+_ERROR = "__error__"
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine knobs. ``model`` is the flagship TransformerConfig; the
+    KV pool holds ``num_blocks`` blocks of ``block_size`` tokens each
+    (block 0 reserved), shared by every live sequence."""
+
+    model: Any = None                  # models.TransformerConfig
+    num_blocks: int = 128
+    block_size: int = 16
+    max_num_seqs: int = 8              # iteration batch cap
+    prefill_token_budget: int = 2048   # prompt tokens admitted per step
+    max_queued_requests: int = 64      # bounded waitqueue (admission)
+    eos_token_id: Optional[int] = None
+    max_new_tokens_default: int = 64
+    param_seed: int = 0
+    cache_dtype: Any = None            # default: model dtype
+
+    def resolved_model(self):
+        if self.model is not None:
+            return self.model
+        from ray_tpu.models import TransformerConfig
+
+        return TransformerConfig()
+
+
+def _pow2_at_least(n: int, floor: int = 1) -> int:
+    m = max(int(n), floor)
+    p = 1
+    while p < m:
+        p *= 2
+    return p
+
+
+class InferenceEngine:
+    """See module docstring. Construct with real ``params`` or let the
+    engine init them from ``param_seed`` (every Serve replica of one
+    deployment then serves identical weights with zero shipping)."""
+
+    def __init__(self, config: Optional[EngineConfig] = None,
+                 params: Optional[dict] = None):
+        import jax
+        from functools import partial
+
+        from ray_tpu.models import (
+            decode_step,
+            init_params,
+            prefill_with_cache,
+        )
+
+        self.config = config or EngineConfig()
+        self.model_cfg = self.config.resolved_model()
+        if params is None:
+            params = init_params(
+                self.model_cfg, jax.random.PRNGKey(self.config.param_seed))
+        self.params = params
+        self.cache = PagedKVCache(
+            self.model_cfg, self.config.num_blocks, self.config.block_size,
+            dtype=self.config.cache_dtype)
+        self.scheduler = Scheduler(
+            self.cache,
+            max_num_seqs=self.config.max_num_seqs,
+            prefill_token_budget=self.config.prefill_token_budget,
+            max_queued_requests=self.config.max_queued_requests)
+        # Donation rewrites the cache in place on accelerators; the CPU
+        # backend only warns, so skip it there to keep logs clean.
+        backend = jax.default_backend()
+        donate = (1,) if backend != "cpu" else ()
+        self._prefill = jax.jit(partial(prefill_with_cache, self.model_cfg),
+                                donate_argnums=donate)
+        self._decode = jax.jit(partial(decode_step, self.model_cfg),
+                               donate_argnums=donate)
+        self._lock = threading.RLock()          # scheduler + cache + step
+        self._work = threading.Event()          # submit -> loop wakeup
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._requests: Dict[int, Request] = {}
+        # -- counters --
+        self.num_steps = 0
+        self.num_prefill_tokens = 0
+        self.num_generated_tokens = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def _ensure_loop(self):
+        if self._loop_thread is None or not self._loop_thread.is_alive():
+            self._loop_thread = threading.Thread(
+                target=self._loop, daemon=True, name="llm-engine-step")
+            self._loop_thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+        with self._lock:
+            for req in list(self._requests.values()):
+                if not req.finished():
+                    # Remove from the waitqueue BEFORE finishing: a loop
+                    # thread already past its stop-check blocks on this
+                    # lock and would otherwise re-admit the CANCELLED
+                    # request (reallocating blocks, streaming past DONE).
+                    self.scheduler.remove_waiting(req)
+                    self._finish(req, CANCELLED)
+        self._work.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._work.wait()
+            if self._stop.is_set():
+                return
+            try:
+                busy = self.step()
+            except Exception as exc:  # noqa: BLE001 — engine must not die
+                # An unexpected step failure (compile error, device OOM)
+                # must not strand consumers on a dead loop thread: fail
+                # every in-flight request TYPED (freeing its blocks) and
+                # keep serving — the next submit sees a clean engine.
+                with self._lock:
+                    for req in list(self._requests.values()):
+                        if not req.finished():
+                            self.scheduler.remove_waiting(req)
+                            self._finish(req, FAILED, exc)
+                busy = True
+                continue
+            if not busy:
+                idle = False
+                with self._lock:
+                    # Check + clear under the submit lock: a concurrent
+                    # submit either lands before the check (not idle) or
+                    # blocks until after the clear and re-sets the event.
+                    if (not self.scheduler.running
+                            and self.scheduler.queue_depth() == 0):
+                        self._work.clear()
+                        idle = True
+                if not idle:
+                    # Defensive: a non-admittable queue must not busy-spin.
+                    time.sleep(0.001)
+
+    # -------------------------------------------------------------- request
+    def submit(self, prompt: List[int],
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0,
+               seed: Optional[int] = None) -> Request:
+        """Enqueue a request; raises EngineQueueFull past the bounded
+        waitqueue. Tokens arrive on ``req.output_queue`` as iterations
+        commit them."""
+        req = Request(
+            prompt,
+            max_new_tokens if max_new_tokens is not None
+            else self.config.max_new_tokens_default,
+            eos_token_id=(eos_token_id if eos_token_id is not None
+                          else self.config.eos_token_id),
+            temperature=temperature, seed=seed)
+        # Reject what can NEVER be admitted (it would park forever at the
+        # FIFO head): a prompt over the per-iteration token budget, or a
+        # full completion larger than the whole pool.
+        if len(req.prompt) > self.config.prefill_token_budget:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} exceeds "
+                f"prefill_token_budget {self.config.prefill_token_budget}")
+        total = len(req.prompt) + req.max_new_tokens
+        if self.cache.blocks_for_tokens(total) > self.cache.usable_blocks:
+            raise KVCacheOOM(
+                f"request needs {self.cache.blocks_for_tokens(total)} "
+                f"blocks for {total} tokens; pool holds "
+                f"{self.cache.usable_blocks}")
+        with self._lock:
+            self.scheduler.submit(req)
+            self._requests[req.seq_id] = req
+            self._work.set()
+        self._ensure_loop()
+        return req
+
+    def generate(self, prompt: List[int],
+                 max_new_tokens: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0,
+                 seed: Optional[int] = None,
+                 timeout_s: float = 120.0) -> Iterator[int]:
+        """Streaming generator of token ids. Closing it mid-generation
+        (``close()`` / GC / a Serve stream cancel) frees the sequence's
+        KV blocks immediately."""
+        req = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          eos_token_id=eos_token_id,
+                          temperature=temperature, seed=seed)
+        try:
+            while True:
+                try:
+                    item = req.output_queue.get(timeout=timeout_s)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"no token for {timeout_s}s (sequence "
+                        f"{req.seq_id}, status {req.status})") from None
+                if isinstance(item, tuple):
+                    kind, payload = item
+                    if kind == _DONE:
+                        return
+                    raise payload  # _ERROR
+                yield item
+        finally:
+            if not req.finished():
+                self.cancel(req)
+
+    def cancel(self, req) -> bool:
+        """Cancel by Request or seq_id: removes it from the waitqueue or
+        the running set and frees its blocks NOW."""
+        with self._lock:
+            if isinstance(req, int):
+                req = self._requests.get(req)
+            if req is None or req.finished():
+                return False
+            self.scheduler.remove_waiting(req)
+            self._finish(req, CANCELLED)
+        self._work.set()  # a parked admission may now fit
+        return True
+
+    def _finish(self, req: Request, status: str,
+                error: Optional[BaseException] = None):
+        self.scheduler.release(req, status, error)
+        self._requests.pop(req.seq_id, None)
+        if status == FAILED and error is not None:
+            req.output_queue.put((_ERROR, error))
+        else:
+            req.output_queue.put((_DONE, status))
+
+    # ----------------------------------------------------------------- step
+    def step(self) -> bool:
+        """Run ONE continuous-batching iteration: admit + prefill + one
+        decode for every running sequence. Returns True if any work ran.
+        Public so tests/bench can drive the engine deterministically."""
+        with self._lock:
+            try:
+                prefills, decodes = self.scheduler.schedule()
+            except MemoryError as e:
+                # A single sequence outgrew the pool: fail it, keep going.
+                for r in list(self.scheduler.running):
+                    self._finish(r, FAILED, KVCacheOOM(str(e)))
+                return True
+            if not prefills and not decodes:
+                # Parked head with nothing running: no future free() can
+                # unpark it (submit-time checks bound single requests, but
+                # fragmentation from a dead pool must not spin forever).
+                if (self.scheduler.queue_depth() > 0
+                        and not self.scheduler.running
+                        and not self.cache.can_allocate(1)):
+                    head = self.scheduler.waiting[0]
+                    self.scheduler.remove_waiting(head)
+                    self._finish(head, FAILED, KVCacheOOM(
+                        "KV pool exhausted with no running sequences to "
+                        "free blocks"))
+                return False
+            if prefills:
+                self._run_prefill(prefills)
+            # Newly prefilled sequences join decode NEXT iteration; their
+            # first token came out of the prefill logits.
+            if decodes:
+                decodes = [r for r in decodes if not r.finished()]
+            if decodes:
+                self._run_decode(decodes)
+            self.num_steps += 1
+            return True
+
+    def _run_prefill(self, reqs: List[Request]):
+        import jax.numpy as jnp
+
+        bs = self.cache.block_size
+        b_pad = _pow2_at_least(len(reqs))
+        max_len = max(len(r.prompt) for r in reqs)
+        s_pad = _pow2_at_least(max_len, bs)
+        tokens = np.zeros((b_pad, s_pad), np.int32)
+        lens = np.ones((b_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            tokens[i, :len(r.prompt)] = r.prompt
+            lens[i] = len(r.prompt)
+        tables = self.cache.padded_tables(
+            [r.seq_id for r in reqs])
+        m_pad = max(_pow2_at_least(tables.shape[1]), s_pad // bs)
+        bt = np.zeros((b_pad, m_pad), np.int32)
+        bt[:len(reqs), :tables.shape[1]] = tables
+        logits, self.cache.data = self._prefill(
+            self.params, self.cache.data, jnp.asarray(tokens),
+            jnp.asarray(lens), jnp.asarray(bt))
+        self.num_prefill_tokens += int(lens[:len(reqs)].sum())
+        self._emit(reqs, np.asarray(logits)[:len(reqs)])
+
+    def _run_decode(self, reqs: List[Request]):
+        import jax.numpy as jnp
+
+        bs = self.cache.block_size
+        b_pad = _pow2_at_least(len(reqs))
+        tokens = np.zeros((b_pad,), np.int32)
+        positions = np.zeros((b_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            tokens[i] = r.last_token
+            positions[i] = r.num_tokens - 1  # slot this step writes
+        tables = self.cache.padded_tables([r.seq_id for r in reqs])
+        m_pad = max(_pow2_at_least(tables.shape[1]),
+                    (int(positions.max()) // bs) + 1)
+        bt = np.zeros((b_pad, m_pad), np.int32)
+        bt[:len(reqs), :tables.shape[1]] = tables
+        logits, self.cache.data = self._decode(
+            self.params, self.cache.data, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(bt))
+        self._emit(reqs, np.asarray(logits)[:len(reqs)])
+
+    def _emit(self, reqs: List[Request], logits: np.ndarray):
+        """Sample one token per request from its logits row, stream it,
+        and retire sequences that hit EOS / their token budget."""
+        for i, req in enumerate(reqs):
+            tok = self._sample(req, logits[i])
+            req.out_tokens.append(tok)
+            self.num_generated_tokens += 1
+            req.output_queue.put(tok)
+            if ((req.eos_token_id is not None and tok == req.eos_token_id)
+                    or len(req.out_tokens) >= req.max_new_tokens):
+                self._finish(req, FINISHED)
+
+    @staticmethod
+    def _sample(req: Request, row: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(row))
+        # Per-request deterministic sampling stream (seeded, host-side).
+        rng = np.random.default_rng(
+            (req.seed if req.seed is not None else req.seq_id,
+             len(req.out_tokens)))
+        z = row.astype(np.float64) / req.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(row), p=p))
+
+    # -------------------------------------------------------------- queries
+    def queue_depth(self) -> int:
+        return self.scheduler.queue_depth()
+
+    def stats(self) -> Dict[str, Any]:
+        out = {
+            "steps": self.num_steps,
+            "prefill_tokens": self.num_prefill_tokens,
+            "generated_tokens": self.num_generated_tokens,
+        }
+        out.update(self.scheduler.stats())
+        out.update(self.cache.stats())
+        return out
+
+    def wait_idle(self, timeout_s: float = 60.0) -> bool:
+        """Block until no work remains (tests/bench convenience)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if (not self.scheduler.running
+                        and self.scheduler.queue_depth() == 0):
+                    return True
+            time.sleep(0.002)
+        return False

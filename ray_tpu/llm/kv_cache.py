@@ -1,0 +1,158 @@
+"""Paged KV cache: fixed-size blocks in preallocated device arrays plus
+the host-side block-table bookkeeping (reference role: vLLM's
+BlockSpaceManager over PagedAttention — Kwon et al.).
+
+The device side is two arrays ``[L, num_blocks, block_size, n_kv_heads,
+head_dim]`` built once by ``models.init_kv_cache`` (the HBM pool). The
+host side is pure integer bookkeeping: a free list and per-sequence
+block tables. Admission, growth, and release move block IDS, never
+bytes — freeing a finished sequence is O(blocks) list appends, and its
+blocks are immediately reusable by any parked request.
+
+Block 0 is the NULL block: it is never handed out, and every padded
+block-table entry (and padded batch row) points at it, so the jitted
+prefill/decode programs can scatter unconditionally — garbage writes
+land in block 0 and the attention mask keeps them out of every softmax.
+
+Accounting counters (``blocks_in_use``, peaks, totals) are the
+observable contract the engine tests pin: a mid-generation ``close()``
+must return the sequence's blocks to the free list immediately.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["KVCacheOOM", "PagedKVCache"]
+
+NULL_BLOCK = 0
+
+
+class KVCacheOOM(RuntimeError):
+    """No free blocks for a required allocation (after eviction)."""
+
+
+class PagedKVCache:
+    """Host-side block manager for one preallocated paged KV pool."""
+
+    def __init__(self, model_cfg, num_blocks: int, block_size: int,
+                 dtype=None):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is NULL)")
+        from ray_tpu.models import init_kv_cache
+
+        self.model_cfg = model_cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.data = init_kv_cache(model_cfg, num_blocks, block_size, dtype)
+        # LIFO free list, block 0 reserved as NULL.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._tables: Dict[int, List[int]] = {}
+        self._lock = threading.Lock()
+        # -- accounting (engine tests/bench read these) --
+        self.peak_blocks_in_use = 0
+        self.total_blocks_allocated = 0
+        self.total_blocks_freed = 0
+
+    # ------------------------------------------------------------- capacity
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # NULL block excluded
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 1) // self.block_size)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_for_tokens(n_tokens) <= len(self._free)
+
+    # ----------------------------------------------------------- allocation
+    def allocate(self, seq_id: int, n_tokens: int) -> bool:
+        """Give ``seq_id`` a fresh table covering ``n_tokens`` positions.
+        Returns False (allocating nothing) when the pool can't cover it —
+        the scheduler parks the request instead of crashing."""
+        need = self.blocks_for_tokens(n_tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id} already allocated")
+            if need > len(self._free):
+                return False
+            blocks = [self._free.pop() for _ in range(need)]
+            self._tables[seq_id] = blocks
+            self.total_blocks_allocated += need
+            self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                          self.blocks_in_use)
+            return True
+
+    def ensure_slot(self, seq_id: int, position: int) -> bool:
+        """Grow ``seq_id``'s table so ``position`` has a physical slot
+        (at most one new block per decode step). False on pool-empty —
+        the scheduler's eviction policy decides who pays."""
+        with self._lock:
+            table = self._tables[seq_id]
+            need_len = position // self.block_size + 1
+            if need_len <= len(table):
+                return True
+            if not self._free:
+                return False
+            table.append(self._free.pop())
+            self.total_blocks_allocated += 1
+            self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                          self.blocks_in_use)
+            return True
+
+    def free(self, seq_id: int) -> int:
+        """Release every block of ``seq_id`` back to the free list.
+        Returns the number of blocks freed (0 if unknown/already freed)."""
+        with self._lock:
+            blocks = self._tables.pop(seq_id, None)
+            if not blocks:
+                return 0
+            self._free.extend(reversed(blocks))
+            self.total_blocks_freed += len(blocks)
+            return len(blocks)
+
+    # -------------------------------------------------------------- queries
+    def table(self, seq_id: int) -> List[int]:
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    def num_seqs(self) -> int:
+        with self._lock:
+            return len(self._tables)
+
+    def padded_tables(self, seq_ids: List[int],
+                      pad_len: Optional[int] = None) -> np.ndarray:
+        """[B, M] int32 block-table batch, rows padded with NULL_BLOCK."""
+        with self._lock:
+            tables = [self._tables[s] for s in seq_ids]
+        m = max((len(t) for t in tables), default=1)
+        m = max(m, pad_len or 1)
+        out = np.full((len(tables), m), NULL_BLOCK, np.int32)
+        for i, t in enumerate(tables):
+            out[i, :len(t)] = t
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "usable_blocks": self.usable_blocks,
+                "blocks_in_use": self.blocks_in_use,
+                "free_blocks": len(self._free),
+                "peak_blocks_in_use": self.peak_blocks_in_use,
+                "total_blocks_allocated": self.total_blocks_allocated,
+                "total_blocks_freed": self.total_blocks_freed,
+                "live_sequences": len(self._tables),
+            }

@@ -1,0 +1,234 @@
+"""Iteration-level (continuous) batching scheduler (reference role:
+Orca's iteration-level scheduling + vLLM's scheduler/policy — admission
+from a bounded waitqueue each step, prefill and decode composed per
+iteration, eviction-by-recompute on KV OOM).
+
+Per engine iteration ``schedule()`` returns the work for ONE step:
+
+- ``prefills``: requests admitted from the waitqueue this iteration —
+  bounded by the prefill token budget (long prompts can't starve the
+  decode batch forever), the running-sequence cap, and KV-pool
+  headroom. Admission allocates the prompt's blocks; a request that
+  doesn't fit PARKS at the head of the queue and is retried every
+  iteration (KV-full never crashes, it waits for blocks to free).
+- ``decodes``: every running sequence, each guaranteed a physical slot
+  for its next token. When the pool is empty mid-decode the YOUNGEST
+  running sequence is preempted (blocks freed, request requeued for
+  full recompute — vLLM's recompute eviction policy), so the oldest
+  work always completes and a long request can never wedge the engine.
+
+Finished/cancelled sequences release their blocks immediately via
+``release()`` — freeing is O(1) list work, so a short request parked
+behind a long one resumes on the very next iteration.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.llm.kv_cache import PagedKVCache
+
+__all__ = ["EngineQueueFull", "Request", "Scheduler",
+           "WAITING", "RUNNING", "FINISHED", "CANCELLED", "FAILED"]
+
+WAITING = "WAITING"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+CANCELLED = "CANCELLED"
+FAILED = "FAILED"
+
+_seq_counter = itertools.count(1)
+
+
+class EngineQueueFull(RuntimeError):
+    """The bounded admission waitqueue is at capacity (backpressure —
+    callers should retry/shed, the engine never buffers unboundedly)."""
+
+
+class Request:
+    """One sequence moving through the engine."""
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0,
+                 seed: Optional[int] = None):
+        if not prompt:
+            raise ValueError("empty prompt")
+        self.seq_id = next(_seq_counter)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.seed = seed
+        self.out_tokens: List[int] = []
+        self.status = WAITING
+        self.error: Optional[BaseException] = None
+        self.preemptions = 0
+        # Token stream to the consumer: ints, then one (sentinel, payload).
+        self.output_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+
+    # Next position to be computed/written in the KV cache.
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt) + len(self.out_tokens)
+
+    @property
+    def last_token(self) -> int:
+        return self.out_tokens[-1] if self.out_tokens else self.prompt[-1]
+
+    def finished(self) -> bool:
+        return self.status in (FINISHED, CANCELLED, FAILED)
+
+
+class Scheduler:
+    """Waitqueue + running set over one PagedKVCache. NOT thread-safe on
+    its own — the engine serializes all calls under its step lock."""
+
+    def __init__(self, cache: PagedKVCache, *, max_num_seqs: int = 8,
+                 prefill_token_budget: int = 2048,
+                 max_queued_requests: int = 64):
+        self.cache = cache
+        self.max_num_seqs = int(max_num_seqs)
+        self.prefill_token_budget = int(prefill_token_budget)
+        self.max_queued_requests = int(max_queued_requests)
+        self.waiting: "deque[Request]" = deque()
+        self.running: List[Request] = []
+        self._lock = threading.Lock()  # waitqueue only (submit vs step)
+        # -- counters --
+        self.num_admitted = 0
+        self.num_preempted = 0
+        self.park_events = 0  # iterations where KV-full parked admission
+
+    # ------------------------------------------------------------ admission
+    def submit(self, req: Request) -> None:
+        with self._lock:
+            if len(self.waiting) >= self.max_queued_requests:
+                raise EngineQueueFull(
+                    f"waitqueue at capacity "
+                    f"({self.max_queued_requests} requests)")
+            self.waiting.append(req)
+
+    def remove_waiting(self, req: Request) -> bool:
+        with self._lock:
+            try:
+                self.waiting.remove(req)
+                return True
+            except ValueError:
+                return False
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self.waiting)
+
+    # ------------------------------------------------------------- schedule
+    def schedule(self) -> Tuple[List[Request], List[Request]]:
+        """Compose one iteration: (prefills admitted now, decode batch).
+        Every returned request has cache slots for the tokens this step
+        will write."""
+        # 1) Guarantee a slot for each running sequence's next token;
+        #    evict-on-OOM: preempt the youngest until the rest fit.
+        decodes: List[Request] = []
+        survivors: List[Request] = []
+        for req in self.running:
+            if req.finished():
+                continue  # release already ran; drop from the set
+            survivors.append(req)
+        self.running = survivors
+        i = 0
+        while i < len(self.running):
+            req = self.running[i]
+            if self.cache.ensure_slot(req.seq_id, req.num_tokens):
+                decodes.append(req)
+                i += 1
+                continue
+            victim = self.running[-1]
+            if victim is req and len(self.running) == 1:
+                # A single sequence that outgrew the whole pool cannot
+                # make progress by eviction; fail it loudly.
+                raise MemoryError(
+                    f"sequence {req.seq_id} needs more KV blocks than "
+                    f"the pool holds ({self.cache.usable_blocks})")
+            self._preempt(victim)
+            decodes = [r for r in decodes if r is not victim]
+            # retry the same index (running list shrank behind it)
+
+        # 2) Admit from the waitqueue under the token budget / seq cap /
+        #    pool headroom. Stop at the first request that doesn't fit:
+        #    FIFO order is the fairness contract (no head-of-line skip).
+        prefills: List[Request] = []
+        budget = self.prefill_token_budget
+        parked = False
+        while True:
+            with self._lock:
+                if not self.waiting:
+                    break
+                req = self.waiting[0]
+                if len(self.running) + len(prefills) >= self.max_num_seqs:
+                    break
+                # The token budget bounds how much prefill joins ONE
+                # iteration, it is not a hard prompt cap: a request may
+                # exceed it when admitted alone (preemption-recompute
+                # legally grows a prompt past the budget — parking it
+                # here forever would wedge the FIFO head; submit() still
+                # rejects fresh prompts over the budget).
+                if len(req.prompt) > budget and prefills:
+                    break
+                # +1 headroom token so the first decode step after
+                # prefill cannot immediately preempt someone.
+                if not self.cache.allocate(req.seq_id,
+                                           len(req.prompt) + 1):
+                    parked = True
+                    break
+                self.waiting.popleft()
+            req.status = RUNNING
+            budget -= len(req.prompt)
+            prefills.append(req)
+            self.running.append(req)
+            self.num_admitted += 1
+        if parked:
+            self.park_events += 1
+        return prefills, decodes
+
+    def _preempt(self, req: Request) -> None:
+        """Recompute-style eviction: drop the sequence's blocks and send
+        it back to the FRONT of the waitqueue. Already-emitted tokens
+        were already streamed; on re-admission the prompt is extended
+        with them so the recompute continues where it left off."""
+        self.cache.free(req.seq_id)
+        req.prompt = req.prompt + req.out_tokens
+        req.max_new_tokens -= len(req.out_tokens)
+        req.out_tokens = []
+        req.status = WAITING
+        req.preemptions += 1
+        self.num_preempted += 1
+        self.running = [r for r in self.running if r is not req]
+        with self._lock:
+            self.waiting.appendleft(req)
+
+    # -------------------------------------------------------------- release
+    def release(self, req: Request, status: str,
+                error: Optional[BaseException] = None) -> int:
+        """Terminal transition: mark + free blocks IMMEDIATELY. Safe to
+        call for any state; returns blocks freed."""
+        req.status = status
+        req.error = error
+        self.running = [r for r in self.running if r is not req]
+        return self.cache.free(req.seq_id)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            waiting = len(self.waiting)
+        return {
+            "waiting": waiting,
+            "running": len(self.running),
+            "max_num_seqs": self.max_num_seqs,
+            "prefill_token_budget": self.prefill_token_budget,
+            "max_queued_requests": self.max_queued_requests,
+            "num_admitted": self.num_admitted,
+            "num_preempted": self.num_preempted,
+            "park_events": self.park_events,
+        }

@@ -7,18 +7,24 @@ directly into the parallelism layer (dp/pp/tp/sp/ep over one Mesh).
 
 from ray_tpu.models.transformer import (
     TransformerConfig,
+    decode_step,
     forward,
+    init_kv_cache,
     init_params,
     loss_fn,
     make_spmd_train_step,
     param_specs,
+    prefill_with_cache,
 )
 
 __all__ = [
     "TransformerConfig",
+    "decode_step",
     "forward",
+    "init_kv_cache",
     "init_params",
     "loss_fn",
     "make_spmd_train_step",
     "param_specs",
+    "prefill_with_cache",
 ]

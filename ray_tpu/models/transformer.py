@@ -162,35 +162,55 @@ def rope(x, positions, theta):
 
 
 def _attention_dense(q, k, v, causal=True):
-    """q [B,S,Hq,Dh], k/v [B,S,Hkv,Dh] -> [B,S,Hq,Dh] (GQA via repeat).
+    """q [B,S,Hq,Dh], k/v [B,S,Hkv,Dh] -> [B,S,Hq,Dh].
 
     On TPU with tileable shapes this dispatches to the Pallas flash
     kernel (ops/flash_attention.py, differentiable via its blockwise
     custom_vjp) — the [S, S] score matrix never hits HBM, which is what
-    unlocks long sequences and large batches under grad. Other
-    shapes/backends take the dense einsum path.
+    unlocks long sequences and large batches under grad. The kernel's
+    blocked matmuls want matched head counts, so GQA repeat-expands K/V
+    only on that path. The dense einsum path keeps GQA GROUPED: queries
+    fold to [B, S, Hkv, group, Dh] and contract against K/V at
+    n_kv_heads width — no n_heads-wide K/V is ever materialized (the
+    same grouped form the paged decode cache relies on).
     """
     B, S, Hq, Dh = q.shape
     Hkv = k.shape[2]
-    if Hq != Hkv:
-        k = jnp.repeat(k, Hq // Hkv, axis=2)
-        v = jnp.repeat(v, Hq // Hkv, axis=2)
-    qT = q.transpose(0, 2, 1, 3)
-    kT = k.transpose(0, 2, 1, 3)
-    vT = v.transpose(0, 2, 1, 3)
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     if on_tpu and S >= 128 and S % 128 == 0 and Dh % 8 == 0:
         from ray_tpu.ops.flash_attention import flash_attention
 
-        o = flash_attention(qT, kT, vT, causal=causal)
+        if Hq != Hkv:
+            k = jnp.repeat(k, Hq // Hkv, axis=2)
+            v = jnp.repeat(v, Hq // Hkv, axis=2)
+        o = flash_attention(q.transpose(0, 2, 1, 3),
+                            k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3), causal=causal)
         return o.transpose(0, 2, 1, 3)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * (Dh ** -0.5)
+    group = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, group, Dh)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * (Dh ** -0.5)
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = jnp.where(mask[None, None, None], s, -1e30)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
-    return o.transpose(0, 2, 1, 3)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
+    return o.reshape(B, S, Hq, Dh)
+
+
+def _project_qkv(cfg, lp, h, positions):
+    """q/k/v projection + rope, shared by the training layer body and
+    the cached prefill/decode paths. h [B, S, D] -> q [B,S,Hq,Dh],
+    k/v [B,S,Hkv,Dh] (k/v at n_kv_heads width)."""
+    dt = cfg.dtype
+    B, S, _ = h.shape
+    Hd = cfg.head_dim
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, -1, Hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, -1, Hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, -1, Hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
 
 
 def _layer_fn(cfg: TransformerConfig, lp: Dict[str, jax.Array], x: jax.Array,
@@ -202,16 +222,11 @@ def _layer_fn(cfg: TransformerConfig, lp: Dict[str, jax.Array], x: jax.Array,
     the local TP shard (wide axis pre-sliced) and attention/MoE take the
     collective axes to use; in GSPMD mode all axes are None."""
     dt = cfg.dtype
-    B, S, D = x.shape
-    Hd = cfg.head_dim
+    B, S, _D = x.shape
 
     # ---- attention ----------------------------------------------------------
     h = rms_norm(x, lp["attn_norm"])
-    q = (h @ lp["wq"].astype(dt)).reshape(B, S, -1, Hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, S, -1, Hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, S, -1, Hd)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q, k, v = _project_qkv(cfg, lp, h, positions)
     if sp_axis is not None:
         Hq, Hkv = q.shape[2], k.shape[2]
         if Hq != Hkv:
@@ -230,6 +245,18 @@ def _layer_fn(cfg: TransformerConfig, lp: Dict[str, jax.Array], x: jax.Array,
 
     # ---- mlp ---------------------------------------------------------------
     h = rms_norm(x, lp["mlp_norm"])
+    return x + _mlp_block(cfg, lp, h, layer_idx,
+                          tp_axis=tp_axis, ep_axis=ep_axis)
+
+
+def _mlp_block(cfg: TransformerConfig, lp: Dict[str, jax.Array],
+               h: jax.Array, layer_idx: jax.Array,
+               tp_axis: Optional[str] = None,
+               ep_axis: Optional[str] = None) -> jax.Array:
+    """Post-norm MLP/MoE for one layer over ``h`` [B, S, D] — shared
+    between the training layer body and the decode path (where S == 1)."""
+    dt = cfg.dtype
+    B, S, D = h.shape
     if cfg.num_experts and "router" in lp:
         is_moe = (layer_idx % cfg.moe_every) == (cfg.moe_every - 1)
         logits = (h.astype(jnp.float32)
@@ -262,13 +289,10 @@ def _layer_fn(cfg: TransformerConfig, lp: Dict[str, jax.Array], x: jax.Array,
             moe_out = (outs[top, jnp.arange(B * S)]
                        * gate[:, None]).reshape(B, S, D)
         if cfg.moe_every == 1:
-            m = moe_out  # all layers MoE: skip the dense branch entirely
-        else:
-            dense_out = _swiglu(cfg, lp, h, tp_axis)
-            m = jnp.where(is_moe, moe_out, dense_out)
-    else:
-        m = _swiglu(cfg, lp, h, tp_axis)
-    return x + m
+            return moe_out  # all layers MoE: skip the dense branch
+        dense_out = _swiglu(cfg, lp, h, tp_axis)
+        return jnp.where(is_moe, moe_out, dense_out)
+    return _swiglu(cfg, lp, h, tp_axis)
 
 
 def _swiglu(cfg, lp, h, tp_axis):
@@ -508,3 +532,127 @@ def shard_params_for_step(params, mesh, pspec):
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, pspec)
+
+
+# ---------------------------------------------------------------------------
+# Inference path: paged KV cache + prefill / single-token decode.
+#
+# The training path above is cacheless (recomputes all K/V every call);
+# serving needs the Orca/vLLM shape — K/V of every processed token persists
+# in fixed-size blocks of preallocated HBM arrays, indexed per sequence
+# through a block table, so the continuous-batching engine
+# (ray_tpu/llm/) admits/evicts sequences by moving integers, never bytes.
+# GQA indexes the cache at n_kv_heads width throughout (grouped queries —
+# see ops/paged_attention.py); the n_heads-wide repeat never exists here.
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: TransformerConfig, num_blocks: int, block_size: int,
+                  dtype: Any = None) -> Dict[str, jax.Array]:
+    """Preallocate the paged KV pool: ``[L, num_blocks, block_size,
+    n_kv_heads, head_dim]`` for K and V. Block 0 is conventionally the
+    NULL block (padding writes land there — see ray_tpu/llm/kv_cache.py);
+    zeros-initialized so unwritten slots are finite and mask-safe."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layers, num_blocks, block_size,
+             cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def prefill_with_cache(cfg: TransformerConfig, params, cache,
+                       tokens: jax.Array, prompt_lens: jax.Array,
+                       block_tables: jax.Array
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Process right-padded prompts, writing every position's K/V into
+    the paged cache, and return the last-real-position logits.
+
+    tokens [B, S] int32 (padded rows/tails may be anything);
+    prompt_lens [B]; block_tables [B, M] with M*block_size >= S (padded
+    entries point at the null block, so out-of-prompt writes are trash
+    writes into block 0 — never another sequence's block).
+
+    Returns (logits [B, vocab] f32 at position prompt_lens-1, new cache).
+    Causality makes the padded tail invisible to every real position, so
+    the result is bit-identical to an unpadded per-sequence run.
+    """
+    B, S = tokens.shape
+    dt = cfg.dtype
+    block_size = cache["k"].shape[2]
+    x = params["embed"].astype(dt)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    # Physical slot of every position: (block_tables[b, s//bs], s % bs).
+    blk = jnp.take_along_axis(block_tables, positions // block_size,
+                              axis=1)                       # [B, S]
+    off = positions % block_size
+
+    def body(carry, lp_idx):
+        x, ck, cv = carry
+        lp, idx = lp_idx
+        h = rms_norm(x, lp["attn_norm"])
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        ck = ck.at[idx, blk, off].set(k)
+        cv = cv.at[idx, blk, off].set(v)
+        o = _attention_dense(q, k, v, causal=True)
+        x = x + o.reshape(B, S, -1) @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["mlp_norm"])
+        x = x + _mlp_block(cfg, lp, h, idx)
+        return (x, ck, cv), None
+
+    idxs = jnp.arange(cfg.n_layers)
+    (x, ck, cv), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]), (params["layers"], idxs))
+    x = rms_norm(x, params["final_norm"])
+    last = jnp.take_along_axis(
+        x, (prompt_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def decode_step(cfg: TransformerConfig, params, cache,
+                tokens: jax.Array, positions: jax.Array,
+                block_tables: jax.Array
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One continuous-batching iteration: each sequence advances by one
+    token against its paged context.
+
+    tokens [B] int32 (the token AT ``positions``, usually last sampled);
+    positions [B] int32 (0-based; context length becomes positions+1);
+    block_tables [B, M] int32. Padded batch rows should carry position 0
+    and a null block table — their writes land in block 0 and their
+    logits are garbage the caller ignores.
+
+    Returns (logits [B, vocab] f32, new cache).
+    """
+    B = tokens.shape[0]
+    dt = cfg.dtype
+    block_size = cache["k"].shape[2]
+    x = params["embed"].astype(dt)[tokens][:, None]  # [B, 1, D]
+    pos2 = positions[:, None]                        # [B, 1]
+    context_lens = positions + 1
+    blk = jnp.take_along_axis(block_tables, pos2 // block_size,
+                              axis=1)[:, 0]          # [B]
+    off = positions % block_size
+
+    from ray_tpu.ops.paged_attention import paged_attention_decode
+
+    def body(carry, lp_idx):
+        x, ck, cv = carry
+        lp, idx = lp_idx
+        h = rms_norm(x, lp["attn_norm"])
+        q, k, v = _project_qkv(cfg, lp, h, pos2)
+        # Write THIS token's k/v, then attend over [0, positions] —
+        # the new slot is part of its own context (self-attention).
+        ck = ck.at[idx, blk, off].set(k[:, 0])
+        cv = cv.at[idx, blk, off].set(v[:, 0])
+        o = paged_attention_decode(
+            q[:, 0], ck[idx], cv[idx], block_tables, context_lens)
+        x = x + (o.reshape(B, 1, -1) @ lp["wo"].astype(dt))
+        h = rms_norm(x, lp["mlp_norm"])
+        x = x + _mlp_block(cfg, lp, h, idx)
+        return (x, ck, cv), None
+
+    idxs = jnp.arange(cfg.n_layers)
+    (x, ck, cv), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]), (params["layers"], idxs))
+    x = rms_norm(x[:, 0], params["final_norm"])
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}

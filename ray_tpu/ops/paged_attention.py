@@ -1,0 +1,67 @@
+"""Decode-path attention over a paged KV cache (vLLM's PagedAttention
+role, reference: Kwon et al. — block-table indirection instead of one
+contiguous KV region per sequence).
+
+The cache is a pool of fixed-size blocks in preallocated arrays
+``[num_blocks, block_size, n_kv_heads, head_dim]``; each sequence owns a
+block table (list of block ids) mapping logical token positions to
+physical slots, so sequences grow/shrink without moving bytes and freed
+blocks are reusable by any sequence.
+
+GQA stays GROUPED end-to-end: queries reshape to
+``[B, n_kv_heads, group, head_dim]`` and contract against the cache at
+``n_kv_heads`` width — the repeat-expanded ``n_heads``-wide K/V that the
+training fallback used to materialize never exists on the decode path
+(at large batch x long context that expansion would dominate HBM
+traffic).
+
+Shapes are decode-step shapes (one query token per sequence):
+
+    q             [B, n_heads, head_dim]
+    k/v cache     [num_blocks, block_size, n_kv_heads, head_dim]
+    block_tables  [B, max_blocks]  int32 (rows padded with the null block)
+    context_lens  [B]              int32 (valid cache tokens per sequence)
+
+This is the jax-level formulation (gather + masked grouped einsum): XLA
+tiles the einsums onto the MXU directly, and it is exact on every
+backend, which is what the engine's token-parity tests pin. A Pallas
+kernel that walks the block table with scalar prefetch (never
+materializing the gathered [B, S, n_kv_heads, head_dim] context in HBM)
+drops in behind the same signature; the dispatch seam below mirrors
+ops/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_attention_decode(q, k_cache, v_cache, block_tables,
+                           context_lens):
+    """Single-token attention of each sequence against its paged context.
+
+    Returns ``[B, n_heads, head_dim]`` in ``q.dtype``. Cache slots at or
+    past ``context_lens[b]`` (including every slot of padded block-table
+    entries) are masked out of the softmax, so trash writes into the
+    null block or not-yet-filled slots never contribute.
+    """
+    B, Hq, Dh = q.shape
+    _, block_size, Hkv, _ = k_cache.shape
+    if Hq % Hkv:
+        raise ValueError(f"n_heads {Hq} % n_kv_heads {Hkv} != 0")
+    group = Hq // Hkv
+    # Gather this batch's context: [B, max_blocks*block_size, Hkv, Dh].
+    k = k_cache[block_tables].reshape(B, -1, Hkv, Dh)
+    v = v_cache[block_tables].reshape(B, -1, Hkv, Dh)
+    s_len = k.shape[1]
+
+    qg = q.reshape(B, Hkv, group, Dh)
+    s = jnp.einsum("bhgd,bshd->bhgs", qg, k) * (Dh ** -0.5)
+    valid = jnp.arange(s_len)[None, :] < context_lens[:, None]  # [B, S]
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhgs,bshd->bhgd", p, v)
+    return o.reshape(B, Hq, Dh)

@@ -164,12 +164,20 @@ class ServeController:
                 the caller side polls them in order. Kept for handles that
                 crossed a process boundary (detached) or replica runtimes
                 without the streaming actor plane; the primary path is
-                ``handle_stream_gen`` above."""
+                ``handle_stream_gen`` above.
+
+                Cancellation protocol: the consumer's ``close()`` writes a
+                ``|cancel`` marker; this loop checks it each yield, and a
+                cancelled (or cancel-raced) producer sweeps every key it
+                wrote instead of committing ``|end`` — abandoned fallback
+                streams must not leak their buffered payloads in the KV."""
                 import pickle as _pickle
 
                 from ray_tpu._private.worker import auto_init
 
                 w = auto_init()
+                base = f"serve|stream|{stream_id}"
+                cancel_key = f"{base}|cancel".encode()
                 args = tuple(
                     ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef)
                     else a for a in args)
@@ -181,16 +189,37 @@ class ServeController:
                 fn = (self._user if method == "__call__"
                       else getattr(self._user, method))
                 seq = 0
+                cancelled = False
                 try:
                     for item in fn(*args, **kwargs):
-                        w.kv_put(f"serve|stream|{stream_id}|{seq}".encode(),
+                        if w.kv_get(cancel_key) is not None:
+                            cancelled = True
+                            break
+                        w.kv_put(f"{base}|{seq}".encode(),
                                  _pickle.dumps(item, protocol=5))
                         seq += 1
                 except Exception as exc:  # noqa: BLE001 — stream error
-                    w.kv_put(f"serve|stream|{stream_id}|err".encode(),
-                             _pickle.dumps(exc))
-                w.kv_put(f"serve|stream|{stream_id}|end".encode(),
-                         str(seq).encode())
+                    w.kv_put(f"{base}|err".encode(), _pickle.dumps(exc))
+                # Cancel handshake (with handle._KVStreamFallbackGenerator
+                # .close): the cancelled side owns the sweep, the normal
+                # side owns committing |end. The producer re-checks the
+                # marker AFTER putting |end and the consumer re-checks
+                # |end AFTER putting |cancel, so whichever write lands
+                # last, one side is guaranteed to observe the other and
+                # run the sweep — no interleaving leaks a key.
+                def sweep():
+                    for i in range(seq):
+                        w.kv_del(f"{base}|{i}".encode())
+                    w.kv_del(f"{base}|err".encode())
+                    w.kv_del(f"{base}|end".encode())
+                    w.kv_del(cancel_key)
+
+                if cancelled or w.kv_get(cancel_key) is not None:
+                    sweep()
+                    return seq
+                w.kv_put(f"{base}|end".encode(), str(seq).encode())
+                if w.kv_get(cancel_key) is not None:
+                    sweep()  # close() raced our final check: we own it
                 return seq
 
             def health_check(self):

@@ -78,7 +78,18 @@ class DeploymentResponseGenerator:
         except BaseException:  # noqa: BLE001 — incl. StopIteration
             self._release()
             raise
-        return ray_tpu.get(ref)
+        try:
+            return ray_tpu.get(ref)
+        except BaseException:  # noqa: BLE001 — lost item / typed error
+            # A failed item materialization ends the stream for this
+            # consumer: cancel the replica's generator (it must stop
+            # doing unaccounted work / holding engine KV blocks) and
+            # release the router slot so autoscaling stops counting it.
+            try:
+                self.close()
+            except Exception:  # noqa: BLE001 — original error wins
+                pass
+            raise
 
     def close(self):
         """Stop consuming: cancels the replica's in-flight generator and
@@ -121,6 +132,61 @@ class _KVStreamFallbackGenerator:
     def __iter__(self):
         return self
 
+    def close(self):
+        """Stop consuming: best-effort cancel of the producing replica
+        task, release the router's in-flight slot NOW — an abandoned
+        fallback stream must stop counting as an ongoing request (the
+        autoscaler reads those counts) — and clean the stream's KV keys.
+        Sweep protocol: if the producer already committed ``|end`` it has
+        exited, so this side sweeps everything; otherwise a ``|cancel``
+        marker is written and the still-running producer sweeps its own
+        writes (covering items committed after this sweep)."""
+        if self._done:
+            return
+        self._done = True
+        try:
+            ray_tpu.cancel(self._inner._to_object_ref())
+        except Exception:  # noqa: BLE001 — cancel is advisory here
+            pass
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            w = global_worker()
+            base = f"serve|stream|{self._stream_id}"
+
+            def sweep_items(seq):
+                while w.kv_del(f"{base}|{seq}".encode()):
+                    seq += 1
+                return seq
+
+            seq = sweep_items(self._seq)
+            w.kv_del(f"{base}|err".encode())
+            if w.kv_del(f"{base}|end".encode()):
+                # Producer exited: re-sweep items it committed between
+                # our first pass and |end landing (TOCTOU window).
+                sweep_items(seq)
+                w.kv_del(f"{base}|err".encode())
+            else:
+                # Producer still running: hand it the sweep baton — and
+                # re-check |end, which closes the handshake against a
+                # producer that committed |end before seeing the marker
+                # (it re-checks |cancel after |end; we re-check |end
+                # after |cancel, so one side always observes the other).
+                w.kv_put(f"{base}|cancel".encode(), b"1")
+                if w.kv_del(f"{base}|end".encode()):
+                    sweep_items(seq)
+                    w.kv_del(f"{base}|err".encode())
+                    w.kv_del(f"{base}|cancel".encode())
+        except Exception:  # noqa: BLE001 — KV cleanup is best-effort
+            pass
+        self._inner._release()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter-teardown safety
+            pass
+
     def __next__(self):
         import pickle
         import time
@@ -141,21 +207,16 @@ class _KVStreamFallbackGenerator:
             err = w.kv_get(f"{base}|err".encode())
             if err is not None:
                 w.kv_del(f"{base}|err".encode())
-                self._finish(w, base)
+                self.close()  # sweep unconsumed items + markers
                 raise pickle.loads(err)
             end = w.kv_get(f"{base}|end".encode())
             if end is not None and self._seq >= int(end):
-                self._finish(w, base)
+                self.close()
                 raise StopIteration
             if time.monotonic() > deadline:
-                self._finish(w, base)
+                self.close()
                 raise TimeoutError("stream stalled for 60s")
             time.sleep(0.002)
-
-    def _finish(self, w, base):
-        self._done = True
-        w.kv_del(f"{base}|end".encode())
-        self._inner._release()
 
 
 class _DetachedRouter:

@@ -100,6 +100,15 @@ class _Handler(BaseHTTPRequestHandler):
                              + "\n").encode()
                     self.wfile.write(
                         f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                finally:
+                    # Client disconnect / mid-stream error: cancel the
+                    # replica's generator and release its in-flight slot
+                    # (an abandoned proxy stream must not count as
+                    # ongoing forever, nor keep generating tokens).
+                    try:
+                        gen.close()
+                    except Exception:  # noqa: BLE001
+                        pass
                 self.wfile.write(b"0\r\n\r\n")
                 return
             result = (handle.remote(arg) if arg is not None

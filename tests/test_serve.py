@@ -243,6 +243,190 @@ def test_http_chunked_streaming():
         stop_proxy()
 
 
+def test_streaming_load_triggers_autoscaling():
+    """Satellite: open DeploymentResponseGenerators count as ongoing
+    requests on their replica until exhausted/closed, so held-open
+    streams (an LLM serving shape) drive scale-up."""
+    @serve.deployment(num_replicas=1, autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.0})
+    class Streamy:
+        def __call__(self):
+            yield "first"
+            time.sleep(60)       # held open far past the test window
+            yield "never"
+
+    handle = serve.run(Streamy.bind())
+    gens = [handle.options(stream=True).remote() for _ in range(4)]
+    try:
+        for g in gens:
+            assert next(g) == "first"
+        ctrl = serve.api.get_or_create_controller()
+        info = ctrl._deployments["Streamy"]
+        assert sum(info.replica_set.queue_lengths()) == 4
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if serve.status()["Streamy"]["target_replicas"] >= 2:
+                break
+            time.sleep(0.1)
+        assert serve.status()["Streamy"]["target_replicas"] >= 2, (
+            "held-open streams did not register as ongoing requests")
+    finally:
+        for g in gens:
+            g.close()
+    # Closed streams release their slots: the signal drains to zero.
+    ctrl = serve.api.get_or_create_controller()
+    info = ctrl._deployments["Streamy"]
+    assert sum(info.replica_set.queue_lengths()) == 0
+
+
+def test_replica_death_mid_stream_typed_error_and_recovery():
+    """Satellite: kill -9 the replica worker while a client consumes a
+    stream — next() must surface a typed error (not hang), and a fresh
+    request must land on a surviving replica."""
+    import os
+    import signal
+
+    @serve.deployment(num_replicas=2)
+    class S:
+        def __call__(self, n):
+            for i in range(n):
+                time.sleep(0.05)
+                yield i
+
+    handle = serve.run(S.bind())
+    gen = handle.options(stream=True).remote(200)
+    assert next(gen) == 0
+    victim_pid = gen._replica._runtime.pid
+    assert victim_pid is not None and victim_pid != os.getpid()
+    os.kill(victim_pid, signal.SIGKILL)
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as exc_info:
+        for _ in range(1000):
+            next(gen)
+    assert not isinstance(exc_info.value, StopIteration)
+    assert time.monotonic() - t0 < 60, "death surfaced too slowly"
+    # A fresh request completes on a surviving (or replaced) replica.
+    deadline = time.monotonic() + 15
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            assert list(handle.options(stream=True).remote(3)) == [0, 1, 2]
+            last_err = None
+            break
+        except Exception as e:  # noqa: BLE001 — routing may briefly
+            last_err = e        # hit the dead replica pre-reconcile
+            time.sleep(0.2)
+    assert last_err is None, f"no surviving replica served: {last_err!r}"
+
+
+def test_kv_fallback_stream_close_sweeps_kv_keys():
+    """Regression: closing (or error/exhaustion-finishing) the thin-client
+    KV fallback stream must leave ZERO serve|stream|<id>|* keys behind —
+    abandoned streams previously leaked every committed-but-unconsumed
+    payload plus the end/err markers in the driver KV."""
+    import uuid
+
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.serve.handle import _KVStreamFallbackGenerator
+
+    @serve.deployment
+    class S:
+        def __call__(self, n):
+            for i in range(n):
+                time.sleep(0.02)
+                yield bytes(1000)
+
+    serve.run(S.bind())
+    ctrl = serve.api.get_or_create_controller()
+    w = global_worker()
+
+    def fallback_stream(n):
+        rs = ctrl._replica_set("S")
+        key, replica = rs.choose()
+        stream_id = uuid.uuid4().hex
+        ref = replica.handle_stream.remote("__call__", (n,), {}, stream_id)
+        return (_KVStreamFallbackGenerator(ref, rs, key, stream_id),
+                f"serve|stream|{stream_id}".encode())
+
+    def assert_swept(prefix):
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if not w.kv_keys(prefix):
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"leaked KV keys: {w.kv_keys(prefix)}")
+
+    # Abandoned mid-stream: close() sweeps and the producer stops.
+    gen, prefix = fallback_stream(50)
+    assert next(gen) == bytes(1000)
+    gen.close()
+    assert_swept(prefix)
+
+    # Fully consumed: exhaustion path sweeps the markers too.
+    gen, prefix = fallback_stream(3)
+    assert len(list(gen)) == 3
+    assert_swept(prefix)
+
+
+def test_llm_app_streaming_cancellation_and_http():
+    """LLM serving e2e: build_llm_app streams tokens over
+    handle.options(stream=True) and chunked HTTP; closing a stream
+    mid-generation frees the engine's KV blocks on the replica."""
+    import json as _json
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import EngineConfig, build_llm_app
+    from ray_tpu.models import TransformerConfig
+
+    mcfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, dtype=jnp.float32)
+    cfg = EngineConfig(model=mcfg, num_blocks=128, block_size=4,
+                       max_num_seqs=4)
+    handle = serve.run(build_llm_app(cfg))
+
+    toks = list(handle.options(stream=True).remote(
+        {"prompt": [1, 2, 3], "max_new_tokens": 6}))
+    assert len(toks) == 6 and all(isinstance(t, int) for t in toks)
+    # Determinism across the serving stack: same request, same tokens.
+    assert list(handle.options(stream=True).remote(
+        {"prompt": [1, 2, 3], "max_new_tokens": 6})) == toks
+
+    # Mid-generation close() -> GeneratorExit on the replica ->
+    # engine.cancel -> blocks freed.
+    gen = handle.options(stream=True).remote(
+        {"prompt": [5, 6, 7, 8], "max_new_tokens": 400})
+    assert next(gen) is not None
+    st = handle.stats.remote().result(timeout=30)
+    assert st["blocks_in_use"] > 0
+    gen.close()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = handle.stats.remote().result(timeout=30)
+        if st["blocks_in_use"] == 0 and st["running"] == 0:
+            break
+        time.sleep(0.1)
+    assert st["blocks_in_use"] == 0, (
+        "cancelled stream did not free its KV blocks")
+
+    # Chunked-HTTP token streaming through the proxy.
+    from ray_tpu.serve.http import start_proxy, stop_proxy
+
+    proxy = start_proxy(port=0)
+    try:
+        url = f"http://{proxy.host}:{proxy.port}/llm?stream=1"
+        req = urllib.request.Request(url, data=_json.dumps(
+            {"prompt": [1, 2, 3], "max_new_tokens": 6}).encode())
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            lines = [_json.loads(x) for x in resp.read().split() if x]
+        assert lines == toks  # same greedy tokens over HTTP
+    finally:
+        stop_proxy()
+
+
 def test_config_file_deploy(tmp_path):
     import json
 
