@@ -1,6 +1,7 @@
 PYTHON ?= python
 
-.PHONY: test bench bench-control-plane bench-llm bench-gate
+.PHONY: test bench bench-control-plane bench-llm bench-llm-prefix \
+	bench-gate
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -18,6 +19,12 @@ bench-control-plane:
 # plus time-to-first-token on the streamed path. Prints one JSON line.
 bench-llm:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite llm_serving
+
+# Prefix-cache-aware serving: tokens/s + TTFT on a prefix-heavy
+# workload (shared system prompt, unique tails) with copy-on-write
+# shared prefix blocks vs the caching-disabled engine. One JSON line.
+bench-llm-prefix:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite llm_prefix
 
 # Regression gate over committed BENCH_pr*.json records: fails when the
 # newest record regresses >20% vs the previous one; required headline

@@ -9,6 +9,9 @@ Endpoints:
 - ``GET /api/tasks``    task states (state API passthrough)
 - ``GET /api/actors``   actor states
 - ``GET /api/workflows`` durable workflow states (journal view)
+- ``GET /api/llm``      live inference-engine counters (scheduler
+  parks/preemptions, block occupancy, prefix-cache hit rate and
+  prefill-tokens-saved — cache effectiveness, live)
 """
 
 from __future__ import annotations
@@ -41,6 +44,7 @@ async function refresh() {
     '<h2>actors</h2>' + table(s.actors) +
     '<h2>object store</h2>' + table(s.object_store) +
     '<h2>workflows</h2>' + table(s.workflows) +
+    '<h2>llm engines</h2>' + table(s.llm) +
     '<h2>workers</h2>' + table(s.workers);
 }
 refresh(); setInterval(refresh, 2000);
@@ -76,6 +80,7 @@ def _snapshot() -> dict:
             "shm": shm,
         },
         "workflows": _workflow_summary(),
+        "llm": _llm_summary(),
         "workers": {
             "mode": w.worker_mode,
             "pool_size": pool.size if pool is not None else 0,
@@ -99,6 +104,30 @@ def _workflow_summary() -> dict:
         return {
             "summary": summarize_workflows(rows),
             "recent": {r.workflow_id: r.status for r in recent},
+        }
+    except Exception as exc:  # noqa: BLE001 — panel must not kill page
+        return {"error": repr(exc)}
+
+
+def _llm_summary() -> dict:
+    """LLM-serving panel: fleet rollup plus per-engine counters (empty
+    when no engine has been constructed this process)."""
+    try:
+        from ray_tpu.util.state import list_llm_engines, \
+            summarize_llm_engines
+
+        rows = list_llm_engines(limit=20)
+        return {
+            "summary": summarize_llm_engines(rows),
+            "engines": {e.engine_id: {
+                "running": e.running,
+                "blocks_in_use": e.blocks_in_use,
+                "prefix_cache_hit_rate": round(
+                    e.prefix_cache_hit_rate, 4),
+                "prefill_tokens_saved": e.prefill_tokens_saved,
+                "park_events": e.park_events,
+                "preemptions": e.num_preempted,
+            } for e in rows},
         }
     except Exception as exc:  # noqa: BLE001 — panel must not kill page
         return {"error": repr(exc)}
@@ -130,6 +159,13 @@ class _Handler(BaseHTTPRequestHandler):
 
                 payload = json.dumps(
                     [w.__dict__ for w in list_workflows(limit=1000)],
+                    default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/llm"):
+                from ray_tpu.util.state import list_llm_engines
+
+                payload = json.dumps(
+                    [e.__dict__ for e in list_llm_engines(limit=100)],
                     default=str).encode()
                 ctype = "application/json"
             else:

@@ -1,21 +1,27 @@
 """LLM inference library (L11): continuous-batching token serving over
 the flagship transformer (reference roles: Ray Serve LLM + vLLM's
-engine — Orca iteration-level batching, PagedAttention KV management).
+engine — Orca iteration-level batching, PagedAttention KV management,
+automatic prefix caching, chunked prefill, tensor-parallel decode).
 
 - ``PagedKVCache`` (kv_cache.py): fixed-size blocks in preallocated
-  device arrays, per-sequence block tables, immediate free/reuse.
-- ``Scheduler`` (scheduler.py): bounded-waitqueue admission, prefill
-  token budget, recompute eviction on KV OOM.
-- ``InferenceEngine`` (engine.py): jitted prefill/decode step loop with
-  streaming per-request token queues.
+  device arrays, per-sequence block tables, refcounted copy-on-write
+  SHARED PREFIX BLOCKS (chain-hashed full blocks; a prompt whose prefix
+  is cached skips that prefill entirely), cached-free LRU tier.
+- ``Scheduler`` (scheduler.py): bounded-waitqueue admission, CHUNKED
+  prefill under the per-iteration token budget (a long prompt can't
+  stall the batch), recompute eviction on KV OOM.
+- ``InferenceEngine`` (engine.py): jitted chunk-prefill/decode step
+  loop with streaming per-request token queues; ``tp_size`` shards the
+  model and the KV pool (along ``n_kv_heads``) across the mesh.
 - ``build_llm_app`` (api.py): Serve deployment builder — token streams
   ride ``handle.options(stream=True)`` / chunked HTTP with per-request
-  cancellation propagating to sequence-free.
+  cancellation propagating to sequence-free; replicas report prefix
+  digests the Serve router scores for cache-affinity routing.
 """
 
 from ray_tpu.llm.api import LLMServer, build_llm_app
-from ray_tpu.llm.engine import EngineConfig, InferenceEngine
-from ray_tpu.llm.kv_cache import KVCacheOOM, PagedKVCache
+from ray_tpu.llm.engine import EngineConfig, InferenceEngine, live_engines
+from ray_tpu.llm.kv_cache import KVCacheOOM, PagedKVCache, chain_digests
 from ray_tpu.llm.scheduler import EngineQueueFull, Request, Scheduler
 
 __all__ = [
@@ -28,4 +34,6 @@ __all__ = [
     "Request",
     "Scheduler",
     "build_llm_app",
+    "chain_digests",
+    "live_engines",
 ]

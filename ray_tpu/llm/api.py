@@ -17,6 +17,13 @@ replica until exhausted or closed (serve router accounting), so a
 deployment built with ``autoscaling_config=`` scales up under
 streaming-heavy load; ``queue_depth()`` additionally exposes the
 engine's parked-admission depth per replica for dashboards/policies.
+
+Prefix-aware routing: every replica exposes ``prefix_digest()`` — the
+chain digests of its cached KV blocks. The Serve controller polls it
+off the request path, and the router scores replicas by cached-prefix
+overlap with each request's prompt, so same-system-prompt traffic
+lands where its prefill is already cached (load-slack bounded; see
+``serve/router.py``).
 """
 
 from __future__ import annotations
@@ -60,6 +67,21 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
+
+    def prefix_digest(self) -> Dict[str, Any]:
+        """Compact cached-prefix report: the chain digests of every
+        registered KV block on this replica (plus the block size they
+        chain over). The Serve controller polls this off the request
+        path and the router scores replicas by cached-prefix overlap —
+        a same-system-prompt request lands where its prefill is already
+        cached."""
+        digests = self.engine.cache.prefix_digest()
+        cap = 8192  # bound the wire payload; truncation is REPORTED
+        return {
+            "block_size": self.engine.cache.block_size,
+            "digests": digests[:cap],
+            "truncated": max(0, len(digests) - cap),
+        }
 
 
 def build_llm_app(engine_config: Optional[EngineConfig] = None, *,

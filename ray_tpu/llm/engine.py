@@ -1,17 +1,31 @@
 """Continuous-batching inference engine over the flagship Transformer
 (reference role: vLLM's LLMEngine / Ray Serve LLM's engine actor).
 
-One ``InferenceEngine`` owns a paged KV cache pool, a continuous-
-batching scheduler, and two jitted programs over ``models.transformer``:
+One ``InferenceEngine`` owns a paged KV cache pool (with copy-on-write
+shared prefix blocks), a continuous-batching scheduler (with chunked
+prefill), and two jitted programs over ``models.transformer``:
 
-- ``prefill_with_cache``: admitted prompts, padded to a (batch, seq)
-  bucket, write their K/V into their allocated blocks in one program
-  and produce each request's FIRST generated token;
-- ``decode_step``: every running sequence advances one token per
-  iteration in one program — Orca's iteration-level batching, so a new
-  request joins the batch at the next step boundary instead of waiting
-  for the batch to drain, and a finished sequence leaves it (and frees
-  its blocks) immediately.
+- ``prefill_chunk``: prompt slices, padded to a (batch, chunk) bucket,
+  write their K/V into their allocated blocks in one program; a slice
+  that completes its prompt produces the request's FIRST generated
+  token. A prompt whose leading blocks hit the prefix cache starts its
+  first chunk at the cached length — the shared tokens are never
+  recomputed (``prefill_tokens_saved``). A prompt longer than the
+  prefill token budget runs as several chunks across iterations, so
+  the running batch's inter-token stall is bounded by one chunk.
+- ``decode_step``: every fully-prefilled sequence advances one token
+  per iteration in one program — Orca's iteration-level batching, so a
+  new request joins the batch at the next step boundary instead of
+  waiting for the batch to drain, and a finished sequence leaves it
+  (and drops its block refs) immediately.
+
+Tensor parallelism (``EngineConfig.tp_size``): the Megatron recipe from
+``parallel/`` grafts onto both programs — per-layer weights column/row
+sharded on the tp mesh axis, the KV pool sharded along ``n_kv_heads``
+(each chip holds its head shard's blocks; block IDS stay global), GSPMD
+inserting the psums — so model + cache scale past one chip while the
+host-side scheduler and block manager are unchanged. TP decode is
+asserted token-for-token identical to single-device decode.
 
 Padding buckets are powers of two, so the number of distinct compiled
 programs is logarithmic in the caps. Padded rows aim at the NULL block
@@ -21,11 +35,13 @@ batch it happened to share an iteration with — the engine's
 concurrent-equals-sequential parity test pins exactly that.
 
 Requests stream: ``generate()`` yields token ids as iterations commit
-them (time-to-first-token ≈ one prefill, not a full completion), and
-closing the consumer (``GeneratorExit``) cancels the sequence — its
-blocks return to the pool immediately, unblocking parked admissions.
-The engine is thread-safe; a Serve replica drives it from concurrent
-streaming handlers with no extra locking.
+them (time-to-first-token ≈ one prefill — one TAIL chunk when the
+prefix cache hits), and closing the consumer (``GeneratorExit``)
+cancels the sequence — its private blocks return to the pool
+immediately (shared prefix blocks stay with their other holders),
+unblocking parked admissions. The engine is thread-safe; a Serve
+replica drives it from concurrent streaming handlers with no extra
+locking.
 """
 
 from __future__ import annotations
@@ -34,7 +50,8 @@ import dataclasses
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+import weakref
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -48,10 +65,23 @@ from ray_tpu.llm.scheduler import (
     Scheduler,
 )
 
-__all__ = ["EngineConfig", "InferenceEngine"]
+__all__ = ["EngineConfig", "InferenceEngine", "live_engines"]
 
 _DONE = "__done__"
 _ERROR = "__error__"
+
+# Live engines in this process, for util/state + the dashboard (weak:
+# observability must never keep a dead engine's KV pool alive).
+_ENGINES: "weakref.WeakValueDictionary[int, InferenceEngine]" = \
+    weakref.WeakValueDictionary()
+_engine_ids = iter(range(1, 1 << 62))
+
+
+def live_engines() -> List["InferenceEngine"]:
+    """Engines constructed in this process and not yet GC'd (shutdown
+    engines remain listed until collected — their final counters are
+    still readable)."""
+    return [e for _, e in sorted(_ENGINES.items())]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,12 +94,14 @@ class EngineConfig:
     num_blocks: int = 128
     block_size: int = 16
     max_num_seqs: int = 8              # iteration batch cap
-    prefill_token_budget: int = 2048   # prompt tokens admitted per step
+    prefill_token_budget: int = 2048   # prompt tokens computed per step
     max_queued_requests: int = 64      # bounded waitqueue (admission)
     eos_token_id: Optional[int] = None
     max_new_tokens_default: int = 64
     param_seed: int = 0
     cache_dtype: Any = None            # default: model dtype
+    enable_prefix_caching: bool = True  # COW shared prefix blocks
+    tp_size: int = 1                   # tensor-parallel mesh width
 
     def resolved_model(self):
         if self.model is not None:
@@ -100,7 +132,7 @@ class InferenceEngine:
         from ray_tpu.models import (
             decode_step,
             init_params,
-            prefill_with_cache,
+            prefill_chunk,
         )
 
         self.config = config or EngineConfig()
@@ -108,10 +140,17 @@ class InferenceEngine:
         if params is None:
             params = init_params(
                 self.model_cfg, jax.random.PRNGKey(self.config.param_seed))
+        self.mesh = None
+        rules = None
+        if self.config.tp_size > 1:
+            self.mesh, rules = self._build_tp_mesh(self.config.tp_size)
+            params = self._shard_params(params, rules)
         self.params = params
         self.cache = PagedKVCache(
             self.model_cfg, self.config.num_blocks, self.config.block_size,
-            dtype=self.config.cache_dtype)
+            dtype=self.config.cache_dtype,
+            enable_prefix_caching=self.config.enable_prefix_caching,
+            mesh=self.mesh, rules=rules)
         self.scheduler = Scheduler(
             self.cache,
             max_num_seqs=self.config.max_num_seqs,
@@ -121,10 +160,14 @@ class InferenceEngine:
         # backend only warns, so skip it there to keep logs clean.
         backend = jax.default_backend()
         donate = (1,) if backend != "cpu" else ()
-        self._prefill = jax.jit(partial(prefill_with_cache, self.model_cfg),
-                                donate_argnums=donate)
-        self._decode = jax.jit(partial(decode_step, self.model_cfg),
-                               donate_argnums=donate)
+        self._prefill_chunk = jax.jit(
+            partial(prefill_chunk, self.model_cfg, mesh=self.mesh,
+                    rules=rules),
+            donate_argnums=donate)
+        self._decode = jax.jit(
+            partial(decode_step, self.model_cfg, mesh=self.mesh,
+                    rules=rules),
+            donate_argnums=donate)
         self._lock = threading.RLock()          # scheduler + cache + step
         self._work = threading.Event()          # submit -> loop wakeup
         self._stop = threading.Event()
@@ -132,8 +175,45 @@ class InferenceEngine:
         self._requests: Dict[int, Request] = {}
         # -- counters --
         self.num_steps = 0
-        self.num_prefill_tokens = 0
+        self.num_prefill_tokens = 0      # prompt tokens actually computed
         self.num_generated_tokens = 0
+        self.engine_id = next(_engine_ids)
+        _ENGINES[self.engine_id] = self
+
+    # ------------------------------------------------------ tensor parallel
+    @staticmethod
+    def _build_tp_mesh(tp: int):
+        """A tp-only mesh over the first ``tp`` devices (the standard
+        framework axes, every other axis size 1, so the default
+        ShardingRules apply unchanged — batch axes become no-op
+        shards)."""
+        import os
+
+        import jax
+
+        from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+        from ray_tpu.parallel.sharding import ShardingRules
+
+        platform = os.environ.get("RAY_TPU_PLATFORM")
+        devices = jax.devices(platform) if platform else jax.devices()
+        if len(devices) < tp:
+            raise ValueError(
+                f"tp_size {tp} exceeds {len(devices)} visible devices")
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, pp=1, tp=tp, sp=1, ep=1),
+                         devices=devices[:tp])
+        return mesh, ShardingRules()
+
+    def _shard_params(self, params, rules):
+        cfg = self.model_cfg
+        if cfg.n_heads % self.config.tp_size or \
+                cfg.n_kv_heads % self.config.tp_size:
+            raise ValueError(
+                f"n_heads {cfg.n_heads} / n_kv_heads {cfg.n_kv_heads} "
+                f"must divide tp_size {self.config.tp_size}")
+        from ray_tpu.models import param_specs
+        from ray_tpu.parallel.sharding import shard_params
+
+        return shard_params(params, self.mesh, param_specs(cfg, rules))
 
     # ------------------------------------------------------------ lifecycle
     def _ensure_loop(self):
@@ -204,14 +284,16 @@ class InferenceEngine:
             eos_token_id=(eos_token_id if eos_token_id is not None
                           else self.config.eos_token_id),
             temperature=temperature, seed=seed)
-        # Reject what can NEVER be admitted (it would park forever at the
-        # FIFO head): a prompt over the per-iteration token budget, or a
-        # full completion larger than the whole pool.
-        if len(req.prompt) > self.config.prefill_token_budget:
-            raise ValueError(
-                f"prompt length {len(req.prompt)} exceeds "
-                f"prefill_token_budget {self.config.prefill_token_budget}")
+        # Reject what can NEVER be served: a completion longer than the
+        # model's context window, or one larger than the whole pool.
+        # (Prompts over the prefill token budget are FINE — chunked
+        # prefill spreads them across iterations.)
         total = len(req.prompt) + req.max_new_tokens
+        max_len = getattr(self.model_cfg, "max_seq_len", None)
+        if max_len is not None and total > max_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the model's "
+                f"max_seq_len {max_len}")
         if self.cache.blocks_for_tokens(total) > self.cache.usable_blocks:
             raise KVCacheOOM(
                 f"request needs {self.cache.blocks_for_tokens(total)} "
@@ -232,7 +314,7 @@ class InferenceEngine:
                  timeout_s: float = 120.0) -> Iterator[int]:
         """Streaming generator of token ids. Closing it mid-generation
         (``close()`` / GC / a Serve stream cancel) frees the sequence's
-        KV blocks immediately."""
+        private KV blocks immediately."""
         req = self.submit(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id,
                           temperature=temperature, seed=seed)
@@ -256,7 +338,7 @@ class InferenceEngine:
 
     def cancel(self, req) -> bool:
         """Cancel by Request or seq_id: removes it from the waitqueue or
-        the running set and frees its blocks NOW."""
+        the running set and drops its block refs NOW."""
         with self._lock:
             if isinstance(req, int):
                 req = self._requests.get(req)
@@ -278,18 +360,19 @@ class InferenceEngine:
 
     # ----------------------------------------------------------------- step
     def step(self) -> bool:
-        """Run ONE continuous-batching iteration: admit + prefill + one
-        decode for every running sequence. Returns True if any work ran.
-        Public so tests/bench can drive the engine deterministically."""
+        """Run ONE continuous-batching iteration: admit + one prefill
+        chunk per prefilling sequence (under the token budget) + one
+        decode for every fully-prefilled sequence. Returns True if any
+        work ran. Public so tests/bench can drive deterministically."""
         with self._lock:
             try:
-                prefills, decodes = self.scheduler.schedule()
+                chunks, decodes = self.scheduler.schedule()
             except MemoryError as e:
                 # A single sequence outgrew the pool: fail it, keep going.
                 for r in list(self.scheduler.running):
                     self._finish(r, FAILED, KVCacheOOM(str(e)))
                 return True
-            if not prefills and not decodes:
+            if not chunks and not decodes:
                 # Parked head with nothing running: no future free() can
                 # unpark it (submit-time checks bound single requests, but
                 # fragmentation from a dead pool must not spin forever).
@@ -302,10 +385,10 @@ class InferenceEngine:
                         "KV pool exhausted with no running sequences to "
                         "free blocks"))
                 return False
-            if prefills:
-                self._run_prefill(prefills)
-            # Newly prefilled sequences join decode NEXT iteration; their
-            # first token came out of the prefill logits.
+            if chunks:
+                self._run_prefill_chunks(chunks)
+            # Newly completed prefills join decode NEXT iteration; their
+            # first token came out of the chunk logits.
             if decodes:
                 decodes = [r for r in decodes if not r.finished()]
             if decodes:
@@ -313,28 +396,48 @@ class InferenceEngine:
             self.num_steps += 1
             return True
 
-    def _run_prefill(self, reqs: List[Request]):
+    def _run_prefill_chunks(self, chunks: List[Tuple[Request, int, int]]):
         import jax.numpy as jnp
 
         bs = self.cache.block_size
-        b_pad = _pow2_at_least(len(reqs))
-        max_len = max(len(r.prompt) for r in reqs)
-        s_pad = _pow2_at_least(max_len, bs)
-        tokens = np.zeros((b_pad, s_pad), np.int32)
+        b_pad = _pow2_at_least(len(chunks))
+        max_chunk = max(n for _, _, n in chunks)
+        c_pad = _pow2_at_least(max_chunk)
+        tokens = np.zeros((b_pad, c_pad), np.int32)
+        starts = np.zeros((b_pad,), np.int32)
         lens = np.ones((b_pad,), np.int32)
-        for i, r in enumerate(reqs):
-            tokens[i, :len(r.prompt)] = r.prompt
-            lens[i] = len(r.prompt)
-        tables = self.cache.padded_tables(
-            [r.seq_id for r in reqs])
-        m_pad = max(_pow2_at_least(tables.shape[1]), s_pad // bs)
+        for i, (r, start, n) in enumerate(chunks):
+            tokens[i, :n] = r.prompt[start:start + n]
+            starts[i] = start
+            lens[i] = n
+        tables = self.cache.padded_tables([r.seq_id for r, _, _ in chunks])
+        # Cover every position this program may touch, including padded
+        # chunk tails (their writes must resolve to real table entries
+        # or the NULL padding, never clamp onto a live block).
+        need_m = max((int(s) + c_pad - 1) // bs + 1
+                     for s in starts[:len(chunks)])
+        m_pad = _pow2_at_least(max(tables.shape[1], need_m))
         bt = np.zeros((b_pad, m_pad), np.int32)
-        bt[:len(reqs), :tables.shape[1]] = tables
-        logits, self.cache.data = self._prefill(
+        bt[:len(chunks), :tables.shape[1]] = tables
+        logits, self.cache.data = self._prefill_chunk(
             self.params, self.cache.data, jnp.asarray(tokens),
-            jnp.asarray(lens), jnp.asarray(bt))
-        self.num_prefill_tokens += int(lens[:len(reqs)].sum())
-        self._emit(reqs, np.asarray(logits)[:len(reqs)])
+            jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(bt))
+        logits = None if not any(
+            start + n >= len(r.prompt) for r, start, n in chunks) \
+            else np.asarray(logits)
+        completed: List[Request] = []
+        rows: List[int] = []
+        for i, (r, start, n) in enumerate(chunks):
+            self.num_prefill_tokens += n
+            r.prefill_pos = start + n
+            # Blocks computed so far become shareable immediately — a
+            # concurrent same-prefix request hits them mid-prefill.
+            self.cache.register_prefix(r.seq_id, r.prefill_pos)
+            if r.prefill_pos >= len(r.prompt):
+                completed.append(r)
+                rows.append(i)
+        if completed:
+            self._emit(completed, logits[rows])
 
     def _run_decode(self, reqs: List[Request]):
         import jax.numpy as jnp
@@ -388,6 +491,8 @@ class InferenceEngine:
 
     def stats(self) -> Dict[str, Any]:
         out = {
+            "engine_id": self.engine_id,
+            "tp_size": self.config.tp_size,
             "steps": self.num_steps,
             "prefill_tokens": self.num_prefill_tokens,
             "generated_tokens": self.num_generated_tokens,

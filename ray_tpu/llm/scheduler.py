@@ -1,25 +1,33 @@
-"""Iteration-level (continuous) batching scheduler (reference role:
-Orca's iteration-level scheduling + vLLM's scheduler/policy — admission
-from a bounded waitqueue each step, prefill and decode composed per
-iteration, eviction-by-recompute on KV OOM).
+"""Iteration-level (continuous) batching scheduler with chunked prefill
+and prefix-cache-aware admission (reference role: Orca's iteration-level
+scheduling + vLLM's scheduler/policy — admission from a bounded
+waitqueue each step, prefill and decode composed per iteration,
+eviction-by-recompute on KV OOM, chunked prefill so one long prompt
+cannot stall the running batch).
 
 Per engine iteration ``schedule()`` returns the work for ONE step:
 
-- ``prefills``: requests admitted from the waitqueue this iteration —
-  bounded by the prefill token budget (long prompts can't starve the
-  decode batch forever), the running-sequence cap, and KV-pool
-  headroom. Admission allocates the prompt's blocks; a request that
+- ``chunks``: ``(request, start, length)`` prefill slices, composed
+  under the prefill token budget. A prompt longer than the budget runs
+  as several chunks across ITERATIONS — between any two of its chunks
+  every running sequence decodes one token, so the batch's inter-token
+  stall is bounded by one chunk's compute, never one prompt's
+  (``max_prefill_tokens_per_step`` pins that bound). Admission
+  allocates the prompt's blocks via ``PagedKVCache.allocate_prefix``:
+  leading blocks already cached are SHARED and their tokens never
+  appear in any chunk (the prefix-cache fast path). A request that
   doesn't fit PARKS at the head of the queue and is retried every
   iteration (KV-full never crashes, it waits for blocks to free).
-- ``decodes``: every running sequence, each guaranteed a physical slot
-  for its next token. When the pool is empty mid-decode the YOUNGEST
-  running sequence is preempted (blocks freed, request requeued for
-  full recompute — vLLM's recompute eviction policy), so the oldest
-  work always completes and a long request can never wedge the engine.
+- ``decodes``: every fully-prefilled running sequence, each guaranteed
+  a writable physical slot for its next token. When the pool is empty
+  mid-decode the YOUNGEST running sequence is preempted (block refs
+  dropped, request requeued for recompute — vLLM's recompute eviction
+  policy; on re-admission its still-cached prefix blocks match again),
+  so the oldest work always completes.
 
-Finished/cancelled sequences release their blocks immediately via
-``release()`` — freeing is O(1) list work, so a short request parked
-behind a long one resumes on the very next iteration.
+Finished/cancelled sequences release their block references immediately
+via ``release()`` — a short request parked behind a long one resumes on
+the very next iteration, and only refcount-0 blocks actually free.
 """
 
 from __future__ import annotations
@@ -65,6 +73,11 @@ class Request:
         self.temperature = float(temperature)
         self.seed = seed
         self.out_tokens: List[int] = []
+        # Prompt tokens whose KV is in the cache (prefix-cache hits at
+        # admission + chunks computed so far). The request decodes only
+        # once this reaches len(prompt).
+        self.prefill_pos = 0
+        self.cached_prompt_tokens = 0  # prefix-cache hits (observability)
         self.status = WAITING
         self.error: Optional[BaseException] = None
         self.preemptions = 0
@@ -79,6 +92,10 @@ class Request:
     @property
     def last_token(self) -> int:
         return self.out_tokens[-1] if self.out_tokens else self.prompt[-1]
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_pos < len(self.prompt)
 
     def finished(self) -> bool:
         return self.status in (FINISHED, CANCELLED, FAILED)
@@ -102,6 +119,9 @@ class Scheduler:
         self.num_admitted = 0
         self.num_preempted = 0
         self.park_events = 0  # iterations where KV-full parked admission
+        self.prefill_chunks_scheduled = 0
+        self.max_prefill_tokens_per_step = 0  # chunked-prefill stall bound
+        self.coscheduled_steps = 0  # iterations with BOTH chunks + decodes
 
     # ------------------------------------------------------------ admission
     def submit(self, req: Request) -> None:
@@ -125,22 +145,25 @@ class Scheduler:
             return len(self.waiting)
 
     # ------------------------------------------------------------- schedule
-    def schedule(self) -> Tuple[List[Request], List[Request]]:
-        """Compose one iteration: (prefills admitted now, decode batch).
-        Every returned request has cache slots for the tokens this step
-        will write."""
-        # 1) Guarantee a slot for each running sequence's next token;
-        #    evict-on-OOM: preempt the youngest until the rest fit.
+    def schedule(self) -> Tuple[List[Tuple[Request, int, int]],
+                                List[Request]]:
+        """Compose one iteration: (prefill chunks, decode batch). Every
+        returned request has cache slots for the tokens this step will
+        write."""
+        self.running = [r for r in self.running if not r.finished()]
+
+        # 1) Guarantee a writable slot for each fully-prefilled running
+        #    sequence's next token; evict-on-OOM: preempt the youngest
+        #    until the rest fit. (Mid-prefill sequences already own every
+        #    block their prompt needs — allocated at admission — so only
+        #    decode growth can run the pool dry.)
         decodes: List[Request] = []
-        survivors: List[Request] = []
-        for req in self.running:
-            if req.finished():
-                continue  # release already ran; drop from the set
-            survivors.append(req)
-        self.running = survivors
         i = 0
         while i < len(self.running):
             req = self.running[i]
+            if req.prefilling:
+                i += 1
+                continue
             if self.cache.ensure_slot(req.seq_id, req.num_tokens):
                 decodes.append(req)
                 i += 1
@@ -156,52 +179,71 @@ class Scheduler:
             decodes = [r for r in decodes if r is not victim]
             # retry the same index (running list shrank behind it)
 
-        # 2) Admit from the waitqueue under the token budget / seq cap /
-        #    pool headroom. Stop at the first request that doesn't fit:
-        #    FIFO order is the fairness contract (no head-of-line skip).
-        prefills: List[Request] = []
+        # 2) Continue chunked prefills of already-running sequences
+        #    (admission order) under the per-iteration token budget.
+        chunks: List[Tuple[Request, int, int]] = []
         budget = self.prefill_token_budget
+        for req in self.running:
+            if budget <= 0:
+                break
+            if req.prefilling:
+                n = min(len(req.prompt) - req.prefill_pos, budget)
+                chunks.append((req, req.prefill_pos, n))
+                budget -= n
+
+        # 3) Admit from the waitqueue under the remaining budget / seq
+        #    cap / pool headroom. Stop at the first request that doesn't
+        #    fit: FIFO order is the fairness contract (no head-of-line
+        #    skip). Admission allocates the FULL prompt's blocks (+1
+        #    headroom token so the first decode step after prefill
+        #    cannot immediately preempt someone), sharing every cached
+        #    prefix block; only the unshared tail enters the chunk plan.
         parked = False
-        while True:
+        while budget > 0:
             with self._lock:
                 if not self.waiting:
                     break
                 req = self.waiting[0]
-                if len(self.running) + len(prefills) >= self.max_num_seqs:
+                if len(self.running) >= self.max_num_seqs:
                     break
-                # The token budget bounds how much prefill joins ONE
-                # iteration, it is not a hard prompt cap: a request may
-                # exceed it when admitted alone (preemption-recompute
-                # legally grows a prompt past the budget — parking it
-                # here forever would wedge the FIFO head; submit() still
-                # rejects fresh prompts over the budget).
-                if len(req.prompt) > budget and prefills:
-                    break
-                # +1 headroom token so the first decode step after
-                # prefill cannot immediately preempt someone.
-                if not self.cache.allocate(req.seq_id,
-                                           len(req.prompt) + 1):
+                cached = self.cache.allocate_prefix(
+                    req.seq_id, req.prompt, extra_tokens=1)
+                if cached is None:
                     parked = True
                     break
                 self.waiting.popleft()
             req.status = RUNNING
-            budget -= len(req.prompt)
-            prefills.append(req)
+            req.prefill_pos = cached
+            req.cached_prompt_tokens = cached
+            n = min(len(req.prompt) - cached, budget)
+            chunks.append((req, cached, n))
+            budget -= n
             self.running.append(req)
             self.num_admitted += 1
         if parked:
             self.park_events += 1
-        return prefills, decodes
+        if chunks:
+            self.prefill_chunks_scheduled += len(chunks)
+            step_tokens = sum(n for _, _, n in chunks)
+            self.max_prefill_tokens_per_step = max(
+                self.max_prefill_tokens_per_step, step_tokens)
+            if decodes:
+                self.coscheduled_steps += 1
+        return chunks, decodes
 
     def _preempt(self, req: Request) -> None:
-        """Recompute-style eviction: drop the sequence's blocks and send
-        it back to the FRONT of the waitqueue. Already-emitted tokens
-        were already streamed; on re-admission the prompt is extended
-        with them so the recompute continues where it left off."""
+        """Recompute-style eviction: drop the sequence's block refs and
+        send it back to the FRONT of the waitqueue. Already-emitted
+        tokens were already streamed; on re-admission the prompt is
+        extended with them so the recompute continues where it left off
+        (and its still-registered prefix blocks match again — a
+        preempted sequence usually re-prefills only what the cache
+        lost)."""
         self.cache.free(req.seq_id)
         req.prompt = req.prompt + req.out_tokens
         req.max_new_tokens -= len(req.out_tokens)
         req.out_tokens = []
+        req.prefill_pos = 0
         req.status = WAITING
         req.preemptions += 1
         self.num_preempted += 1
@@ -212,8 +254,10 @@ class Scheduler:
     # -------------------------------------------------------------- release
     def release(self, req: Request, status: str,
                 error: Optional[BaseException] = None) -> int:
-        """Terminal transition: mark + free blocks IMMEDIATELY. Safe to
-        call for any state; returns blocks freed."""
+        """Terminal transition: mark + drop block refs IMMEDIATELY (only
+        refcount-0 blocks actually free — shared prefix blocks stay with
+        their other holders). Safe to call for any state; returns blocks
+        freed."""
         req.status = status
         req.error = error
         self.running = [r for r in self.running if r is not req]
@@ -231,4 +275,7 @@ class Scheduler:
             "num_admitted": self.num_admitted,
             "num_preempted": self.num_preempted,
             "park_events": self.park_events,
+            "prefill_chunks_scheduled": self.prefill_chunks_scheduled,
+            "max_prefill_tokens_per_step": self.max_prefill_tokens_per_step,
+            "coscheduled_steps": self.coscheduled_steps,
         }

@@ -14,6 +14,7 @@ from ray_tpu.models.transformer import (
     loss_fn,
     make_spmd_train_step,
     param_specs,
+    prefill_chunk,
     prefill_with_cache,
 )
 
@@ -26,5 +27,6 @@ __all__ = [
     "loss_fn",
     "make_spmd_train_step",
     "param_specs",
+    "prefill_chunk",
     "prefill_with_cache",
 ]

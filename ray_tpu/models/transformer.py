@@ -161,23 +161,35 @@ def rope(x, positions, theta):
                             x1 * sin + x2 * cos], axis=-1)
 
 
-def _attention_dense(q, k, v, causal=True):
+def _attention_dense(q, k, v, causal=True, grad=True):
     """q [B,S,Hq,Dh], k/v [B,S,Hkv,Dh] -> [B,S,Hq,Dh].
 
     On TPU with tileable shapes this dispatches to the Pallas flash
     kernel (ops/flash_attention.py, differentiable via its blockwise
     custom_vjp) — the [S, S] score matrix never hits HBM, which is what
     unlocks long sequences and large batches under grad. The kernel's
-    blocked matmuls want matched head counts, so GQA repeat-expands K/V
-    only on that path. The dense einsum path keeps GQA GROUPED: queries
-    fold to [B, S, Hkv, group, Dh] and contract against K/V at
-    n_kv_heads width — no n_heads-wide K/V is ever materialized (the
-    same grouped form the paged decode cache relies on).
+    FA2 backward wants matched head counts, so GQA repeat-expands K/V
+    only on the differentiable (``grad=True``, training) path;
+    inference callers pass ``grad=False`` and take the GROUPED flash
+    forward (``flash_attention_grouped``), whose K/V block specs
+    index-map each query head to its kv group — no n_heads-wide K/V
+    exists anywhere on the serving path. The dense einsum path keeps
+    GQA GROUPED too: queries fold to [B, S, Hkv, group, Dh] and
+    contract against K/V at n_kv_heads width (the same grouped form the
+    paged decode cache relies on).
     """
     B, S, Hq, Dh = q.shape
     Hkv = k.shape[2]
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     if on_tpu and S >= 128 and S % 128 == 0 and Dh % 8 == 0:
+        if Hq != Hkv and not grad:
+            from ray_tpu.ops.flash_attention import flash_attention_grouped
+
+            o = flash_attention_grouped(q.transpose(0, 2, 1, 3),
+                                        k.transpose(0, 2, 1, 3),
+                                        v.transpose(0, 2, 1, 3),
+                                        causal=causal)
+            return o.transpose(0, 2, 1, 3)
         from ray_tpu.ops.flash_attention import flash_attention
 
         if Hq != Hkv:
@@ -535,7 +547,7 @@ def shard_params_for_step(params, mesh, pspec):
 
 
 # ---------------------------------------------------------------------------
-# Inference path: paged KV cache + prefill / single-token decode.
+# Inference path: paged KV cache + prefill / chunked prefill / decode.
 #
 # The training path above is cacheless (recomputes all K/V every call);
 # serving needs the Orca/vLLM shape — K/V of every processed token persists
@@ -544,7 +556,23 @@ def shard_params_for_step(params, mesh, pspec):
 # (ray_tpu/llm/) admits/evicts sequences by moving integers, never bytes.
 # GQA indexes the cache at n_kv_heads width throughout (grouped queries —
 # see ops/paged_attention.py); the n_heads-wide repeat never exists here.
+#
+# Tensor parallelism: every function below takes optional ``mesh``/
+# ``rules``. With a mesh, the Megatron recipe from ``parallel/`` is
+# grafted onto the cached path — wq/wk/wv column-sharded on tp (per-chip
+# head shards), wo/w_down row-sharded (GSPMD inserts the psum), and the
+# KV pool sharded along n_kv_heads (parallel.sharding.kv_cache_specs),
+# so model + cache scale past one chip while block bookkeeping stays
+# global integers. Constraints keep activations on the tp axis between
+# the projections; without a mesh every constraint is a no-op.
 # ---------------------------------------------------------------------------
+
+
+def _infer_constrain(x, mesh, rules, *logical):
+    """Sharding annotation for the inference path (no-op without mesh)."""
+    from ray_tpu.parallel.sharding import constrain_logical
+
+    return constrain_logical(x, mesh, rules, *logical)
 
 def init_kv_cache(cfg: TransformerConfig, num_blocks: int, block_size: int,
                   dtype: Any = None) -> Dict[str, jax.Array]:
@@ -591,7 +619,7 @@ def prefill_with_cache(cfg: TransformerConfig, params, cache,
         q, k, v = _project_qkv(cfg, lp, h, positions)
         ck = ck.at[idx, blk, off].set(k)
         cv = cv.at[idx, blk, off].set(v)
-        o = _attention_dense(q, k, v, causal=True)
+        o = _attention_dense(q, k, v, causal=True, grad=False)
         x = x + o.reshape(B, S, -1) @ lp["wo"].astype(dt)
         h = rms_norm(x, lp["mlp_norm"])
         x = x + _mlp_block(cfg, lp, h, idx)
@@ -607,9 +635,83 @@ def prefill_with_cache(cfg: TransformerConfig, params, cache,
     return logits, {"k": ck, "v": cv}
 
 
+def prefill_chunk(cfg: TransformerConfig, params, cache,
+                  tokens: jax.Array, start_pos: jax.Array,
+                  chunk_lens: jax.Array, block_tables: jax.Array,
+                  mesh=None, rules=None
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Process one CHUNK of each prompt against the paged cache: tokens
+    ``[B, C]`` are each sequence's prompt slice starting at absolute
+    position ``start_pos[b]``, attending over everything already in the
+    cache (prefix-cache hits, earlier chunks) plus the chunk itself.
+
+    This one program is both halves of the prefill fast path:
+
+    - **chunked prefill** — a long prompt runs as several calls with
+      advancing ``start_pos``, so the decode batch's inter-token stall
+      is bounded by one chunk, not one prompt;
+    - **prefix-cache skip** — a prompt whose leading blocks were shared
+      by ``PagedKVCache.allocate_prefix`` starts its FIRST chunk at the
+      cached length and never recomputes the shared tokens.
+
+    tokens [B, C] int32 (rows/tails may be anything past chunk_lens);
+    start_pos [B]; chunk_lens [B] (valid tokens in this chunk);
+    block_tables [B, M] covering position start_pos + C - 1 (padded
+    entries point at the null block — out-of-range writes are trash
+    writes into block 0, masked out of every softmax).
+
+    Returns (logits [B, vocab] f32 at the chunk's LAST valid position —
+    meaningful only for rows whose chunk completes the prompt — and the
+    new cache).
+    """
+    B, C = tokens.shape
+    dt = cfg.dtype
+    block_size = cache["k"].shape[2]
+    M = block_tables.shape[1]
+    x = params["embed"].astype(dt)[tokens]
+    positions = start_pos[:, None] + jnp.arange(C)[None, :]    # [B, C]
+    blk = jnp.take_along_axis(
+        block_tables, jnp.minimum(positions // block_size, M - 1),
+        axis=1)                                                # [B, C]
+    off = positions % block_size
+
+    from ray_tpu.ops.paged_attention import paged_attention_prefill
+
+    def body(carry, lp_idx):
+        x, ck, cv = carry
+        lp, idx = lp_idx
+        h = rms_norm(x, lp["attn_norm"])
+        q, k, v = _project_qkv(cfg, lp, h, positions)
+        q = _infer_constrain(q, mesh, rules, None, None, "heads",
+                             "head_dim")
+        k = _infer_constrain(k, mesh, rules, None, None, "kv_heads",
+                             "head_dim")
+        v = _infer_constrain(v, mesh, rules, None, None, "kv_heads",
+                             "head_dim")
+        # Write the chunk's K/V, then attend over [0, position] per
+        # token — each new slot is part of its own context.
+        ck = ck.at[idx, blk, off].set(k)
+        cv = cv.at[idx, blk, off].set(v)
+        o = paged_attention_prefill(q, ck[idx], cv[idx], block_tables,
+                                    positions, mesh=mesh, rules=rules)
+        x = x + o.reshape(B, C, -1) @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["mlp_norm"])
+        x = x + _mlp_block(cfg, lp, h, idx)
+        return (x, ck, cv), None
+
+    idxs = jnp.arange(cfg.n_layers)
+    (x, ck, cv), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]), (params["layers"], idxs))
+    x = rms_norm(x, params["final_norm"])
+    last = jnp.take_along_axis(
+        x, (chunk_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
 def decode_step(cfg: TransformerConfig, params, cache,
                 tokens: jax.Array, positions: jax.Array,
-                block_tables: jax.Array
+                block_tables: jax.Array, mesh=None, rules=None
                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One continuous-batching iteration: each sequence advances by one
     token against its paged context.
@@ -639,12 +741,19 @@ def decode_step(cfg: TransformerConfig, params, cache,
         lp, idx = lp_idx
         h = rms_norm(x, lp["attn_norm"])
         q, k, v = _project_qkv(cfg, lp, h, pos2)
+        q = _infer_constrain(q, mesh, rules, None, None, "heads",
+                             "head_dim")
+        k = _infer_constrain(k, mesh, rules, None, None, "kv_heads",
+                             "head_dim")
+        v = _infer_constrain(v, mesh, rules, None, None, "kv_heads",
+                             "head_dim")
         # Write THIS token's k/v, then attend over [0, positions] —
         # the new slot is part of its own context (self-attention).
         ck = ck.at[idx, blk, off].set(k[:, 0])
         cv = cv.at[idx, blk, off].set(v[:, 0])
         o = paged_attention_decode(
-            q[:, 0], ck[idx], cv[idx], block_tables, context_lens)
+            q[:, 0], ck[idx], cv[idx], block_tables, context_lens,
+            mesh=mesh, rules=rules)
         x = x + (o.reshape(B, 1, -1) @ lp["wo"].astype(dt))
         h = rms_norm(x, lp["mlp_norm"])
         x = x + _mlp_block(cfg, lp, h, idx)

@@ -6,12 +6,16 @@ jax implementation off-TPU (CPU tests) or when shapes don't fit the TPU
 tiling constraints, so every call site is portable.
 """
 
-from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_grouped,
+)
 from ray_tpu.ops.fused import rms_norm_fused, softmax_cross_entropy
 from ray_tpu.ops.paged_attention import paged_attention_decode
 
 __all__ = [
     "flash_attention",
+    "flash_attention_grouped",
     "paged_attention_decode",
     "rms_norm_fused",
     "softmax_cross_entropy",

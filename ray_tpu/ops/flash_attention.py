@@ -169,6 +169,24 @@ def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _fallback_grouped(q, k, v, causal, scale):
+    """Grouped-GQA dense reference: q [B, Hq, S, D] folds to
+    [B, Hkv, group, S, D] and contracts against K/V at n_kv_heads width
+    — no n_heads-wide K/V is ever materialized."""
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
+    group = Hq // Hkv
+    qg = q.reshape(B, Hkv, group, Sq, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k) * scale
+    if causal:
+        sk = k.shape[2]
+        mask = jnp.arange(Sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p, v)
+    return o.reshape(B, Hq, Sq, D)
+
+
 def _auto_block(seq: int, cap: int = 512) -> int:
     """Largest power-of-2 divisor of `seq`, capped. Measured on TPU v5e
     (seq 1024-4096, head dim 64/128): 512x512 tiles run the forward
@@ -214,6 +232,96 @@ def flash_attention(
     block_k = min(block_k, Sk)
     return _flash_core(q, k, v, causal, scale, block_q, block_k,
                        bool(interpret))
+
+
+def flash_attention_grouped(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """GQA flash attention with K/V kept at ``n_kv_heads`` width:
+    q [B, Hq, S, D], k/v [B, Hkv, S, D] (Hkv divides Hq) -> [B, Hq, S, D].
+
+    The grid runs one program per QUERY head; each program's K/V block
+    specs index-map to the head's kv group — the repeat-expanded
+    n_heads-wide K/V that ``flash_attention`` requires never exists in
+    HBM (at inference batch x context that expansion is pure wasted
+    bandwidth). FORWARD-ONLY: the FA2 backward kernels want matched
+    head counts, so the differentiable training path keeps the expanded
+    form; inference (prefill-with-cache) dispatches here.
+    """
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    if Hq % Hkv:
+        raise ValueError(f"n_heads {Hq} % n_kv_heads {Hkv} != 0")
+    if scale is None:
+        scale = D ** -0.5
+    if Hq == Hkv:
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if interpret is None:
+        interpret = not on_tpu
+    if block_q is None:
+        block_q = _auto_block(Sq)
+    if block_k is None:
+        block_k = _auto_block(Sk)
+    if (Sq % min(block_q, Sq) or Sk % min(block_k, Sk)
+            or Sq < 8 or Sk < 8 or D % 8):
+        return _fallback_grouped(q, k, v, causal, scale)
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    return _flash_forward_grouped(q, k, v, causal, scale, block_q,
+                                  block_k, bool(interpret))
+
+
+def _flash_forward_grouped(q, k, v, causal, scale, block_q, block_k,
+                           interpret):
+    """Same online-softmax kernel as ``_flash_forward``; only the K/V
+    BlockSpec index maps differ — program ``b`` over the flattened
+    [B*Hq] axis reads kv row ``(b // Hq) * Hkv + (b % Hq) // group``."""
+    from jax.experimental import pallas as pl
+
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    group = Hq // Hkv
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, seq_k=Sk, causal=causal,
+        scale=scale, block_q=block_q)
+
+    qr = q.reshape(B * Hq, Sq, D)
+    kr = k.reshape(B * Hkv, Sk, D)
+    vr = v.reshape(B * Hkv, Sk, D)
+
+    def kv_index(b, i):
+        return ((b // Hq) * Hkv + (b % Hq) // group, 0, 0)
+
+    out, _lse = pl.pallas_call(
+        kernel,
+        grid=(B * Hq, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Sk, D), kv_index),
+            pl.BlockSpec((None, Sk, D), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 8, Sq), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * Hq, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * Hq, 8, Sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, Hq, Sq, D)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):

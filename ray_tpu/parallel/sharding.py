@@ -67,6 +67,19 @@ def with_sharding_constraint(x, mesh: Optional[Mesh], spec: P):
     return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def constrain_logical(x, mesh: Optional[Mesh],
+                      rules: Optional[ShardingRules],
+                      *logical: Optional[str]):
+    """Constrain an intermediate by LOGICAL axis names (no-op without a
+    mesh) — the shared hook the inference path (models/transformer.py,
+    ops/paged_attention.py) uses to graft Megatron TP onto cached
+    prefill/decode."""
+    if mesh is None:
+        return x
+    r = rules or ShardingRules()
+    return with_sharding_constraint(x, mesh, r.spec(*logical))
+
+
 def shard_params(params: Any, mesh: Mesh, spec_tree: Any) -> Any:
     """Device-put a parameter pytree according to a matching tree of
     PartitionSpecs (as produced by a model's ``param_specs()``)."""
@@ -75,6 +88,18 @@ def shard_params(params: Any, mesh: Mesh, spec_tree: Any) -> Any:
 
     return jax.tree.map(_put, params, spec_tree,
                         is_leaf=lambda x: x is None)
+
+
+def kv_cache_specs(rules: Optional[ShardingRules] = None) -> dict:
+    """PartitionSpec tree for the paged KV pool ``{"k", "v"}`` arrays
+    (``[L, num_blocks, block_size, n_kv_heads, head_dim]``): sharded
+    along ``n_kv_heads`` so tensor-parallel decode keeps each chip's
+    cache shard private to its attention-head shard — block IDS stay
+    global (the host block manager is oblivious to the mesh), block
+    BYTES never cross chips."""
+    r = rules or ShardingRules()
+    spec = P(None, None, None, r.kv_heads, None)
+    return {"k": spec, "v": spec}
 
 
 def param_sharding_tree(mesh: Mesh, spec_tree: Any) -> Any:

@@ -40,6 +40,7 @@ class DeploymentInfo:
     status: str = "UPDATING"
     request_count: int = 0
     last_scale_change: float = 0.0
+    last_prefix_poll: float = 0.0
 
 
 class ServeController:
@@ -55,6 +56,13 @@ class ServeController:
             target=self._reconcile_loop, daemon=True,
             name="serve-controller")
         self._thread.start()
+        # Prefix-digest telemetry on its OWN thread: a slow or dying
+        # replica blocking a 2s poll must never delay autoscaling or
+        # dead-replica replacement in the reconcile loop.
+        self._prefix_thread = threading.Thread(
+            target=self._prefix_poll_loop, daemon=True,
+            name="serve-prefix-poll")
+        self._prefix_thread.start()
 
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, cls: type, init_args, init_kwargs,
@@ -94,6 +102,39 @@ class ServeController:
                 self._reconcile_once()
             except Exception:  # noqa: BLE001 — keep the controller alive
                 pass
+
+    # ---------------------------------------------------- prefix telemetry
+    _PREFIX_POLL_INTERVAL_S = 1.0
+
+    def _prefix_poll_loop(self):
+        while not self._stop.wait(0.5):
+            try:
+                self._poll_prefix_digests()
+            except Exception:  # noqa: BLE001 — telemetry best-effort
+                pass
+
+    def _poll_prefix_digests(self):
+        """Refresh each prefix-capable deployment's replica digest
+        reports (LLM replicas expose ``prefix_digest()`` — the cached
+        block-chain hashes). The router scores replicas by cached-prefix
+        overlap from these reports, entirely off the request path;
+        stale reports only cost a routing hit, never correctness."""
+        now = time.monotonic()
+        with self._lock:
+            infos = [i for i in self._deployments.values()
+                     if hasattr(i.cls, "prefix_digest")
+                     and now - i.last_prefix_poll
+                     > self._PREFIX_POLL_INTERVAL_S]
+        for info in infos:
+            info.last_prefix_poll = now
+            for r in list(info.replicas):
+                try:
+                    ref = r.handle_request.remote("prefix_digest", (), {})
+                    report = ray_tpu.get(ref, timeout=2.0)
+                    info.replica_set.update_prefix_digest(
+                        id(r), report["block_size"], report["digests"])
+                except Exception:  # noqa: BLE001 — telemetry best-effort
+                    pass
 
     def _reconcile_once(self):
         with self._lock:

@@ -250,6 +250,20 @@ def _rebuild_deployment_handle(name, method, stream, replicas):
     return handle
 
 
+def _extract_prefix_tokens(args, kwargs):
+    """Token prompt of an LLM-shaped request, for prefix-aware routing:
+    the first positional arg (or ``request=``) as either a token list or
+    a dict carrying ``"prompt"``. Anything else returns None — non-LLM
+    deployments route exactly as before."""
+    req = args[0] if args else kwargs.get("request")
+    if isinstance(req, dict):
+        req = req.get("prompt")
+    if (isinstance(req, (list, tuple)) and req
+            and all(isinstance(t, int) for t in req)):
+        return list(req)
+    return None
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
                  method_name: str = "__call__", stream: bool = False):
@@ -273,7 +287,14 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         rs = self._controller._replica_set(self._name)
-        key, replica = rs.choose()
+        # Prefix-aware tier: when any replica has reported a prefix
+        # digest (LLM deployments), score replicas by cached-prefix
+        # overlap with the request's prompt — a hit routes the request
+        # where its prefill is already cached.
+        prefix_tokens = None
+        if rs.has_prefix_digests():
+            prefix_tokens = _extract_prefix_tokens(args, kwargs)
+        key, replica = rs.choose(prefix_tokens=prefix_tokens)
         # Chain: unwrap DeploymentResponses into ObjectRefs so downstream
         # deployments receive resolved values without blocking here.
         args = tuple(

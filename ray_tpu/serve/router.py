@@ -1,11 +1,34 @@
 """Replica scheduling (reference role: serve/_private/replica_scheduler/
-pow_2_scheduler.py — power-of-two-choices on replica queue length)."""
+pow_2_scheduler.py — power-of-two-choices on replica queue length, plus
+a prefix-cache-aware tier for LLM deployments).
+
+Prefix-aware routing: replicas that expose a prefix digest (the LLM
+engine's registered block-chain hashes — see
+``PagedKVCache.prefix_digest``) are scored by **cached-prefix overlap**
+with the incoming prompt: the router chains the prompt's block digests
+and counts how many LEADING blocks each replica already holds. The
+best-overlap replica wins — a request landing there skips recomputing
+the shared prefill entirely — unless it is drastically more loaded than
+the least-loaded replica (the same resident-bytes-with-load-slack idiom
+``remote_router._choose_node`` uses for data locality: locality wins,
+but never into a hotspot). Requests with no overlap (or deployments
+that never report digests) fall through to power-of-two-choices
+untouched.
+"""
 
 from __future__ import annotations
 
 import random
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+# A cached-prefix hit must cover at least this many tokens to override
+# the load-balancing choice (one block is the minimum shareable unit).
+PREFIX_MIN_OVERLAP_TOKENS = 1
+# Max extra in-flight requests the overlap winner may carry vs the
+# least-loaded replica before locality yields to load (the
+# locality_load_slack idiom from the task router).
+PREFIX_LOAD_SLACK = 2
 
 
 class ReplicaSet:
@@ -18,8 +41,14 @@ class ReplicaSet:
         # transfer to whichever replica now occupies that slot and skew the
         # power-of-two choice.
         self._inflight: Dict[int, int] = {}
+        # Prefix-cache reports keyed the same way: id(replica) ->
+        # (block_size, frozenset of chain digests).
+        self._prefix: Dict[int, Tuple[int, frozenset]] = {}
         self._lock = threading.Lock()
         self._rng = random.Random(0)
+        # -- counters (tests/dashboards read these) --
+        self.prefix_routed = 0          # requests routed by overlap
+        self.prefix_overlap_tokens = 0  # cumulative overlap they carried
 
     def update(self, replicas: List[Any]):
         with self._lock:
@@ -28,28 +57,97 @@ class ReplicaSet:
             self._inflight = {
                 k: v for k, v in self._inflight.items() if k in live
             }
+            self._prefix = {
+                k: v for k, v in self._prefix.items() if k in live
+            }
 
     def size(self) -> int:
         with self._lock:
             return len(self._replicas)
 
-    def choose(self) -> (int, Any):
-        """Power of two choices: sample two replicas, pick the one with the
-        shorter queue. Falls back to the single replica when size==1.
+    # ---------------------------------------------------------- prefix tier
+    def update_prefix_digest(self, key: int, block_size: int,
+                             digests) -> None:
+        """Record one replica's cached-prefix report (the controller
+        polls ``prefix_digest()`` off the request path)."""
+        with self._lock:
+            self._prefix[key] = (int(block_size), frozenset(digests))
+
+    def has_prefix_digests(self) -> bool:
+        with self._lock:
+            return bool(self._prefix)
+
+    def _prefix_candidate(self, digests_by_bs) -> Optional[Any]:
+        """Best replica by contiguous leading-block overlap, or None
+        when nothing (usefully) matches / the winner is overloaded.
+        Caller holds the lock; the prompt digests were hashed OUTSIDE
+        it (``digests_by_bs``: block_size -> chain digests)."""
+        best, best_tokens = None, 0
+        for r in self._replicas:
+            ent = self._prefix.get(id(r))
+            if ent is None:
+                continue
+            bs, dset = ent
+            digs = digests_by_bs.get(bs)
+            if digs is None:
+                continue  # report arrived between snapshot and scoring
+            overlap = 0
+            for d in digs:
+                if d not in dset:
+                    break
+                overlap += 1
+            tokens = overlap * bs
+            if tokens > best_tokens:
+                best, best_tokens = r, tokens
+        if best is None or best_tokens < PREFIX_MIN_OVERLAP_TOKENS:
+            return None
+        min_inflight = min(
+            (self._inflight.get(id(r), 0) for r in self._replicas),
+            default=0)
+        if self._inflight.get(id(best), 0) > min_inflight + \
+                PREFIX_LOAD_SLACK:
+            return None  # cached replica is a hotspot: balance instead
+        self.prefix_routed += 1
+        self.prefix_overlap_tokens += best_tokens
+        return best
+
+    # -------------------------------------------------------------- choose
+    def choose(self, prefix_tokens=None) -> (int, Any):
+        """Prefix-overlap scoring when ``prefix_tokens`` is given and a
+        replica reported digests; otherwise power of two choices: sample
+        two replicas, pick the one with the shorter queue. Falls back to
+        the single replica when size==1.
 
         Returns (key, replica); pass the key back to release()."""
+        # Hash the prompt OUTSIDE the lock (a 4k prompt is hundreds of
+        # chained blake2b links — concurrent routing must not serialize
+        # on it); only the cheap set-overlap scoring holds the lock.
+        digests_by_bs = None
+        if prefix_tokens is not None:
+            with self._lock:
+                sizes = {bs for bs, _ in self._prefix.values()}
+            if sizes:
+                from ray_tpu.llm.kv_cache import chain_digests
+
+                digests_by_bs = {
+                    bs: chain_digests(prefix_tokens, bs) for bs in sizes
+                }
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas available")
-            if n == 1:
-                replica = self._replicas[0]
-            else:
-                a, b = self._rng.sample(range(n), 2)
-                ra, rb = self._replicas[a], self._replicas[b]
-                qa = self._inflight.get(id(ra), 0)
-                qb = self._inflight.get(id(rb), 0)
-                replica = ra if qa <= qb else rb
+            replica = None
+            if digests_by_bs and n > 1 and self._prefix:
+                replica = self._prefix_candidate(digests_by_bs)
+            if replica is None:
+                if n == 1:
+                    replica = self._replicas[0]
+                else:
+                    a, b = self._rng.sample(range(n), 2)
+                    ra, rb = self._replicas[a], self._replicas[b]
+                    qa = self._inflight.get(id(ra), 0)
+                    qb = self._inflight.get(id(rb), 0)
+                    replica = ra if qa <= qb else rb
             key = id(replica)
             self._inflight[key] = self._inflight.get(key, 0) + 1
             return key, replica

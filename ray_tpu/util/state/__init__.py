@@ -36,6 +36,32 @@ class WorkflowState:
 
 
 @dataclass
+class LLMEngineState:
+    """One live inference engine's operational counters (the cache-
+    effectiveness view operators watch: park/preemption pressure, block
+    occupancy, prefix-cache hit rate and prefill tokens saved)."""
+
+    engine_id: int
+    tp_size: int
+    steps: int
+    running: int
+    waiting: int
+    generated_tokens: int
+    prefill_tokens: int
+    blocks_in_use: int
+    free_blocks: int
+    cached_free_blocks: int
+    park_events: int
+    num_preempted: int
+    prefix_cache_queries: int
+    prefix_cache_hits: int
+    prefill_tokens_saved: int
+    prefix_cache_hit_rate: float
+    cow_copies: int
+    max_prefill_tokens_per_step: int
+
+
+@dataclass
 class ObjectState:
     object_id: str
     ready: bool
@@ -110,6 +136,62 @@ def list_workflows(filters: Optional[List] = None,
         if len(out) >= limit:
             break
     return out
+
+
+def list_llm_engines(limit: int = 100) -> List[LLMEngineState]:
+    """Inference engines alive in this process (`ray list llm-engines`
+    role): the PR 5 scheduler counters (parks, preemptions, block
+    occupancy) plus the prefix-cache effectiveness counters (hit rate,
+    prefill tokens saved, copy-on-write copies) — what the dashboard's
+    /api/llm endpoint serves."""
+    try:
+        from ray_tpu.llm.engine import live_engines
+    except Exception:  # noqa: BLE001 — llm layer optional (needs jax)
+        return []
+    out: List[LLMEngineState] = []
+    for eng in live_engines()[:limit]:
+        st = eng.stats()
+        out.append(LLMEngineState(
+            engine_id=st["engine_id"],
+            tp_size=st["tp_size"],
+            steps=st["steps"],
+            running=st["running"],
+            waiting=st["waiting"],
+            generated_tokens=st["generated_tokens"],
+            prefill_tokens=st["prefill_tokens"],
+            blocks_in_use=st["blocks_in_use"],
+            free_blocks=st["free_blocks"],
+            cached_free_blocks=st["cached_free_blocks"],
+            park_events=st["park_events"],
+            num_preempted=st["num_preempted"],
+            prefix_cache_queries=st["prefix_cache_queries"],
+            prefix_cache_hits=st["prefix_cache_hits"],
+            prefill_tokens_saved=st["prefill_tokens_saved"],
+            prefix_cache_hit_rate=st["prefix_cache_hit_rate"],
+            cow_copies=st["cow_copies"],
+            max_prefill_tokens_per_step=st["max_prefill_tokens_per_step"],
+        ))
+    return out
+
+
+def summarize_llm_engines(
+        engines: Optional[List[LLMEngineState]] = None) -> Dict[str, Any]:
+    """Fleet-level cache-effectiveness rollup (dashboard panel)."""
+    rows = engines if engines is not None else list_llm_engines()
+    saved = sum(e.prefill_tokens_saved for e in rows)
+    computed = sum(e.prefill_tokens for e in rows)
+    return {
+        "num_engines": len(rows),
+        "running": sum(e.running for e in rows),
+        "waiting": sum(e.waiting for e in rows),
+        "generated_tokens": sum(e.generated_tokens for e in rows),
+        "blocks_in_use": sum(e.blocks_in_use for e in rows),
+        "park_events": sum(e.park_events for e in rows),
+        "num_preempted": sum(e.num_preempted for e in rows),
+        "prefill_tokens_saved": saved,
+        "prefix_cache_hit_rate": (
+            saved / (saved + computed) if (saved + computed) else 0.0),
+    }
 
 
 def summarize_workflows(

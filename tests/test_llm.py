@@ -338,18 +338,18 @@ def test_step_failure_fails_requests_typed_and_engine_recovers(params):
     the loop thread silently — in-flight requests fail TYPED (blocks
     freed) and the engine keeps serving subsequent submits."""
     engine = _engine(params)
-    good_prefill = engine._prefill
+    good_prefill = engine._prefill_chunk
 
     def boom(*a, **k):
         raise RuntimeError("poisoned step")
 
-    engine._prefill = boom
+    engine._prefill_chunk = boom
     gen = engine.generate([1, 2, 3], max_new_tokens=4, timeout_s=30)
     with pytest.raises(RuntimeError, match="poisoned step"):
         next(gen)
     st = engine.stats()
     assert st["blocks_in_use"] == 0 and st["running"] == 0
-    engine._prefill = good_prefill
+    engine._prefill_chunk = good_prefill
     assert len(list(engine.generate([1, 2, 3], max_new_tokens=4))) == 4
     engine.shutdown()
 
@@ -482,3 +482,399 @@ def test_kv_fallback_stream_close_releases_slot():
     assert rs.queue_lengths() == [0]
     gen.close()
     assert rs.queue_lengths() == [0]
+
+
+# ===================================================================
+# PR 7: prefix caching / chunked prefill / TP decode / prefix router
+# ===================================================================
+
+def test_paged_attention_prefill_matches_dense_reference():
+    """ops-level: chunk attention over the paged cache (cached prefix +
+    in-chunk causal in one position mask) == dense reference."""
+    from ray_tpu.ops.paged_attention import paged_attention_prefill
+
+    key = jax.random.PRNGKey(3)
+    B, C, Hq, Hkv, Dh, bs = 2, 4, 4, 2, 8, 4
+    total_lens = [10, 7]          # full context incl. the chunk
+    starts = [6, 3]               # chunk covers [start, start+C)
+    n_blocks = 16
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, C, Hq, Dh), jnp.float32)
+    k_ctx = jax.random.normal(kk, (B, 12, Hkv, Dh), jnp.float32)
+    v_ctx = jax.random.normal(kv, (B, 12, Hkv, Dh), jnp.float32)
+
+    rng = np.random.default_rng(1)
+    k_cache = np.zeros((n_blocks, bs, Hkv, Dh), np.float32)
+    v_cache = np.zeros((n_blocks, bs, Hkv, Dh), np.float32)
+    free = list(rng.permutation(np.arange(1, n_blocks)))
+    tables = np.zeros((B, 3), np.int32)
+    for b in range(B):
+        n_blk = -(-int(total_lens[b]) // bs)
+        blocks = [free.pop() for _ in range(n_blk)]
+        tables[b, :n_blk] = blocks
+        for pos in range(int(total_lens[b])):
+            k_cache[blocks[pos // bs], pos % bs] = k_ctx[b, pos]
+            v_cache[blocks[pos // bs], pos % bs] = v_ctx[b, pos]
+
+    q_positions = np.array([[s + i for i in range(C)] for s in starts],
+                           np.int32)
+    out = paged_attention_prefill(
+        q, jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(tables), jnp.asarray(q_positions))
+
+    for b in range(B):
+        for i in range(C):
+            p = starts[b] + i
+            if p >= total_lens[b]:
+                continue  # padded tail rows are garbage by contract
+            k = np.repeat(k_ctx[b, :p + 1], Hq // Hkv, axis=1)
+            v = np.repeat(v_ctx[b, :p + 1], Hq // Hkv, axis=1)
+            s = np.einsum("hd,lhd->hl", np.asarray(q[b, i]), k) * Dh ** -0.5
+            pr = np.exp(s - s.max(-1, keepdims=True))
+            pr /= pr.sum(-1, keepdims=True)
+            ref = np.einsum("hl,lhd->hd", pr, v)
+            np.testing.assert_allclose(np.asarray(out[b, i]), ref,
+                                       atol=1e-5)
+
+
+def test_flash_attention_grouped_matches_expanded():
+    """Satellite: the grouped GQA flash forward (kv block specs
+    index-mapped per query head, no repeat-expanded K/V) must equal the
+    repeat-expanded formulation — kernel path and fallback path."""
+    from ray_tpu.ops.flash_attention import (
+        _fallback,
+        flash_attention_grouped,
+    )
+
+    key = jax.random.PRNGKey(4)
+    B, Hq, Hkv, S, D = 2, 8, 2, 64, 16
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, Hq, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(kv, (B, Hkv, S, D), jnp.float32)
+    k_rep = jnp.repeat(k, Hq // Hkv, axis=1)
+    v_rep = jnp.repeat(v, Hq // Hkv, axis=1)
+    for causal in (True, False):
+        out = flash_attention_grouped(q, k, v, causal=causal,
+                                      block_q=16, block_k=16,
+                                      interpret=True)
+        ref = _fallback(q, k_rep, v_rep, causal, D ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+    # Non-tileable shapes take the grouped dense fallback.
+    out = flash_attention_grouped(q[:, :, :12], k[:, :, :12], v[:, :, :12],
+                                  causal=True)
+    ref = _fallback(q[:, :, :12], k_rep[:, :, :12], v_rep[:, :, :12],
+                    True, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# ------------------------------------ acceptance (a): prefix-cache skip
+def test_prefix_cache_skips_shared_prefix_counter_asserted(params):
+    """Two requests sharing a long prompt prefix produce greedy outputs
+    token-for-token identical to the caching-disabled engine, while the
+    second request's prefill computes ONLY the unshared tail."""
+    prefix = list(range(1, 25))       # 24 tokens = 6 full blocks (bs 4)
+    p1 = prefix + [30, 31, 32]
+    p2 = prefix + [40, 41]
+
+    ref_engine = _engine(params, enable_prefix_caching=False)
+    ref1 = list(ref_engine.generate(p1, max_new_tokens=6))
+    assert ref_engine.wait_idle(30)
+    ref2 = list(ref_engine.generate(p2, max_new_tokens=6))
+    assert ref_engine.wait_idle(30)
+    ref_engine.shutdown()
+
+    engine = _engine(params)
+    out1 = list(engine.generate(p1, max_new_tokens=6))
+    assert engine.wait_idle(30)
+    computed_before = engine.num_prefill_tokens
+    out2 = list(engine.generate(p2, max_new_tokens=6))
+    assert engine.wait_idle(30)
+
+    assert out1 == ref1
+    assert out2 == ref2
+    st = engine.stats()
+    assert st["prefill_tokens_saved"] == len(prefix)
+    assert st["prefix_cache_hits"] == 1
+    # The second prefill computed exactly the unshared tail.
+    assert engine.num_prefill_tokens - computed_before == len(p2) - 24
+    assert st["blocks_in_use"] == 0
+    engine.shutdown()
+
+
+def test_fully_cached_prompt_copies_on_write(params):
+    """A request whose ENTIRE prompt is cached still computes its last
+    position (for logits) — writing into the final shared block, which
+    must copy-on-write while the donor sequence keeps decoding on the
+    original block, streams unaffected."""
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]  # exactly 2 full blocks (bs 4)
+    ref_engine = _engine(params, enable_prefix_caching=False)
+    ref_a = list(ref_engine.generate(prompt, max_new_tokens=12))
+    assert ref_engine.wait_idle(30)
+    ref_b = list(ref_engine.generate(prompt, max_new_tokens=5))
+    assert ref_engine.wait_idle(30)
+    ref_engine.shutdown()
+
+    engine = _engine(params, num_blocks=48)
+    g1 = engine.generate(prompt, max_new_tokens=12)
+    first = next(g1)  # prefill landed -> prompt blocks registered, live
+    out2 = list(engine.generate(prompt, max_new_tokens=5))
+    st = engine.stats()
+    assert st["cow_copies"] >= 1, "shared tail block was not COW'd"
+    assert st["prefill_tokens_saved"] == len(prompt) - 1
+    out1 = [first] + list(g1)
+    assert out1 == ref_a, "donor stream corrupted by the COW"
+    assert out2 == ref_b
+    assert _poll(lambda: engine.stats()["blocks_in_use"] == 0)
+    engine.shutdown()
+
+
+# ------------------------------------ acceptance (b): chunked prefill
+def test_chunked_prefill_bounds_batch_stall(params):
+    """A prompt far over the prefill token budget is admitted (no
+    rejection) and prefills as several chunks across ITERATIONS — the
+    running batch's inter-token stall is bounded by one chunk budget
+    (counter-asserted) and decode keeps flowing between chunks."""
+    budget = 8
+    long_prompt = list(range(1, 33))   # 32 tokens = 4 chunks of 8
+    engine = _engine(params, prefill_token_budget=budget, num_blocks=64)
+    short = engine.submit([9, 8, 7], max_new_tokens=30)
+    assert _poll(lambda: len(short.out_tokens) >= 2)
+    r_long = engine.submit(long_prompt, max_new_tokens=4)
+    out_long = _drain(r_long)
+    out_short = _drain(short)
+    assert len(out_long) == 4 and len(out_short) == 30
+    st = engine.stats()
+    assert st["max_prefill_tokens_per_step"] <= budget
+    assert st["prefill_chunks_scheduled"] >= 5  # short + 4 long chunks
+    assert st["coscheduled_steps"] >= 3, (
+        "decode stalled while the long prompt prefilled")
+    engine.shutdown()
+
+    # Parity: chunked prefill changes WHEN tokens compute, never WHAT
+    # they are — same greedy outputs as a one-shot prefill.
+    ref_engine = _engine(params, prefill_token_budget=256,
+                         enable_prefix_caching=False)
+    assert list(ref_engine.generate(long_prompt, max_new_tokens=4)) == \
+        out_long
+    ref_engine.shutdown()
+
+
+# ------------------------------------ acceptance (c): TP decode parity
+def test_tp_decode_matches_single_device(params):
+    """Tensor-parallel decode over the mesh (params column/row sharded,
+    KV cache sharded along n_kv_heads) produces token-for-token
+    identical greedy outputs to the single-device engine."""
+    prompts = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10, 11, 12, 13]]
+    outs = {}
+    for tp in (1, 2):
+        engine = _engine(params, tp_size=tp, enable_prefix_caching=False)
+        if tp > 1:
+            assert engine.mesh is not None
+        outs[tp] = []
+        for p in prompts:
+            outs[tp].append(list(engine.generate(p, max_new_tokens=10)))
+            assert engine.wait_idle(60)
+        engine.shutdown()
+    assert outs[1] == outs[2], "TP decode diverged from single-device"
+
+
+def test_tp_prefill_and_decode_logits_close(params):
+    """Program-level TP check: the sharded prefill_chunk + decode_step
+    produce logits matching the unsharded programs."""
+    from ray_tpu.llm.engine import InferenceEngine as IE
+    from ray_tpu.models import init_kv_cache, prefill_chunk
+    from ray_tpu.models.transformer import decode_step
+    from ray_tpu.parallel.sharding import kv_cache_specs, shard_params
+    from jax.sharding import NamedSharding
+
+    mesh, rules = IE._build_tp_mesh(2)
+    from ray_tpu.models import param_specs
+
+    sharded = shard_params(params, mesh, param_specs(MODEL, rules))
+    specs = kv_cache_specs(rules)
+
+    prompt = [3, 17, 5, 9, 22, 11]
+    table = np.zeros((1, 4), np.int32)
+    table[0, :2] = [5, 9]
+    toks = np.zeros((1, 8), np.int32)
+    toks[0, :6] = prompt
+
+    def run(p, cache, mesh_, rules_):
+        lg, cache = prefill_chunk(
+            MODEL, p, cache, jnp.asarray(toks), jnp.asarray([0]),
+            jnp.asarray([6]), jnp.asarray(table), mesh=mesh_,
+            rules=rules_)
+        tok = int(np.argmax(np.asarray(lg[0])))
+        lg2, cache = decode_step(
+            MODEL, p, cache, jnp.asarray([tok]), jnp.asarray([6]),
+            jnp.asarray(table), mesh=mesh_, rules=rules_)
+        return np.asarray(lg[0]), np.asarray(lg2[0])
+
+    base1, base2 = run(params, init_kv_cache(MODEL, 16, 4), None, None)
+    import jax as _jax
+
+    cache_tp = {
+        k: _jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in init_kv_cache(MODEL, 16, 4).items()
+    }
+    tp1, tp2 = run(sharded, cache_tp, mesh, rules)
+    np.testing.assert_allclose(tp1, base1, atol=1e-5)
+    np.testing.assert_allclose(tp2, base2, atol=1e-5)
+
+
+# --------------------------- satellite: shared-block lifecycle churn
+def test_shared_block_refcount_lifecycle_unit():
+    """Cache-level churn proof: freeing a sequence that shares prefix
+    blocks frees ONLY its private blocks; zero-ref registered blocks
+    park in the cached-free tier; a reclaimed block's digest entries
+    are gone, so a racing admit can never resurrect it."""
+    cache = PagedKVCache(MODEL, num_blocks=32, block_size=4)
+    prompt = list(range(1, 18))  # 17 tokens: 4 full blocks + tail
+    assert cache.allocate_prefix(1, prompt) == 0  # cold cache
+    cache.register_prefix(1, len(prompt))
+    assert cache.allocate_prefix(2, prompt) == 16
+    t1, t2 = cache.table(1), cache.table(2)
+    assert t1[:4] == t2[:4], "leading full blocks should be shared"
+    assert t1[4:] != t2[4:]
+    for b in t1[:4]:
+        assert cache.refcount(b) == 2
+    # Mid-decode close of seq 2: only its private block(s) free.
+    private_2 = len(t2) - 4
+    assert cache.free(2) == private_2
+    for b in t1[:4]:
+        assert cache.refcount(b) == 1, "shared block freed with seq 2"
+    # Recompute-preemption analogue for seq 1 (same release path): its
+    # registered blocks PARK in cached-free, still matchable.
+    cache.free(1)
+    assert cache.blocks_in_use == 0
+    assert cache.cached_free_blocks == 4
+    assert cache.allocate_prefix(3, prompt) == 16  # hit from cached-free
+    cache.free(3)
+    # Reclaim the whole pool -> cached blocks evicted + deregistered.
+    assert cache.allocate(4, 31 * 4)
+    assert cache.stats()["cached_blocks_evicted"] == 4
+    cache.free(4)
+    # Racing admit after reclamation: the old digests must NOT match.
+    hits_before = cache.prefix_cache_hits
+    assert cache.allocate_prefix(5, prompt) == 0
+    assert cache.prefix_cache_hits == hits_before, (
+        "reclaimed block resurrected via a stale digest")
+
+
+def test_close_with_shared_prefix_keeps_donor_stream_intact(params):
+    """Engine-level churn: closing a sequence that shares prefix blocks
+    with a live one must not disturb the donor's tokens, and the shared
+    blocks must survive (parked, not leaked) after both are gone."""
+    prefix = list(range(1, 17))  # 4 full blocks
+    ref_engine = _engine(params, enable_prefix_caching=False)
+    ref = list(ref_engine.generate(prefix + [21], max_new_tokens=12))
+    assert ref_engine.wait_idle(30)
+    ref_engine.shutdown()
+
+    engine = _engine(params, num_blocks=64)
+    g1 = engine.generate(prefix + [21], max_new_tokens=12)
+    first = next(g1)
+    g2 = engine.generate(prefix + [22], max_new_tokens=40)
+    next(g2)
+    assert engine.stats()["prefill_tokens_saved"] >= len(prefix)
+    g2.close()  # mid-decode: frees only g2's private blocks
+    out1 = [first] + list(g1)
+    assert out1 == ref, "donor stream corrupted by sharer's close()"
+    assert _poll(lambda: engine.stats()["blocks_in_use"] == 0)
+    st = engine.stats()
+    assert st["cached_free_blocks"] >= 4  # shared prefix parked for reuse
+    engine.shutdown()
+
+
+# ------------------------------------- satellite: prefix-aware router
+def test_prefix_router_prefers_cached_replica():
+    """Router unit: the replica whose digest report overlaps the
+    request's prompt prefix wins — until it is overloaded past the
+    locality slack, when power-of-two takes back over."""
+    from ray_tpu.llm.kv_cache import chain_digests
+    from ray_tpu.serve.router import PREFIX_LOAD_SLACK, ReplicaSet
+
+    a, b = object(), object()
+    rs = ReplicaSet()
+    rs.update([a, b])
+    prompt = list(range(64))
+    digs = chain_digests(prompt, 4)
+    rs.update_prefix_digest(id(b), 4, digs)
+
+    keys = []
+    for i in range(PREFIX_LOAD_SLACK + 1):
+        key, r = rs.choose(prefix_tokens=prompt)
+        assert r is b, f"cache-affinity choice {i} missed"
+        keys.append(key)
+    assert rs.prefix_routed == PREFIX_LOAD_SLACK + 1
+    assert rs.prefix_overlap_tokens == (PREFIX_LOAD_SLACK + 1) * 64
+    # b now carries slack+1 in-flight vs a's 0: locality must yield.
+    key, r = rs.choose(prefix_tokens=prompt)
+    assert r is a, "overloaded cached replica not load-balanced away"
+    for k in keys + [key]:
+        rs.release(k)
+    # Longest contiguous overlap wins; a gap stops the chain.
+    rs.update_prefix_digest(id(a), 4, [digs[0], digs[2]])
+    key, r = rs.choose(prefix_tokens=prompt)
+    assert r is b
+    rs.release(key)
+    # No overlap at all -> plain pow-2 (never raises).
+    key, r = rs.choose(prefix_tokens=[999] * 16)
+    rs.release(key)
+    assert rs.prefix_routed == PREFIX_LOAD_SLACK + 2
+
+
+def test_prefix_router_handle_extraction():
+    """The handle only attempts prompt extraction for LLM-shaped
+    requests; everything else routes exactly as before."""
+    from ray_tpu.serve.handle import _extract_prefix_tokens
+
+    assert _extract_prefix_tokens(([1, 2, 3],), {}) == [1, 2, 3]
+    assert _extract_prefix_tokens(
+        ({"prompt": [4, 5], "max_new_tokens": 2},), {}) == [4, 5]
+    assert _extract_prefix_tokens(({"text": "hi"},), {}) is None
+    assert _extract_prefix_tokens(("hello",), {}) is None
+    assert _extract_prefix_tokens((), {}) is None
+    assert _extract_prefix_tokens(([1, "x"],), {}) is None
+
+
+# -------------------------------------- satellite: engine observability
+def test_llm_engine_observability_state_and_dashboard(params):
+    """util/state.list_llm_engines + the dashboard /api/llm endpoint
+    expose the scheduler + prefix-cache counters live."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import dashboard as dash_mod
+    from ray_tpu.util.state import list_llm_engines, summarize_llm_engines
+
+    engine = _engine(params)
+    prompt = list(range(1, 10))
+    assert len(list(engine.generate(prompt, max_new_tokens=4))) == 4
+    assert engine.wait_idle(30)
+    list(engine.generate(prompt, max_new_tokens=4))  # prefix hit
+
+    rows = [e for e in list_llm_engines()
+            if e.engine_id == engine.engine_id]
+    assert rows, "engine missing from util/state listing"
+    st = rows[0]
+    assert st.generated_tokens >= 8
+    assert st.prefix_cache_hits >= 1
+    assert st.prefill_tokens_saved >= 8
+    assert st.prefix_cache_hit_rate > 0
+    roll = summarize_llm_engines()
+    assert roll["num_engines"] >= 1
+    assert roll["prefill_tokens_saved"] >= 8
+
+    dash = dash_mod.Dashboard(port=0)
+    try:
+        raw = urllib.request.urlopen(dash.url + "/api/llm",
+                                     timeout=10).read()
+        data = _json.loads(raw)
+        mine = [e for e in data if e["engine_id"] == engine.engine_id]
+        assert mine and mine[0]["prefix_cache_hits"] >= 1
+    finally:
+        dash.shutdown()
+    engine.shutdown()
