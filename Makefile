@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: test bench bench-control-plane bench-llm bench-llm-prefix \
-	bench-gate
+	bench-gate bench-chaos chaos-gate
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -25,6 +25,24 @@ bench-llm:
 # shared prefix blocks vs the caching-disabled engine. One JSON line.
 bench-llm-prefix:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite llm_prefix
+
+# Chaos x load SLO probe: hundreds of concurrent token streams through
+# a 2-replica LLM deployment with a replica SIGKILLed mid-load and
+# low-priority traffic shed by policy; records p99 TTFT and the
+# effective success rate (shed-by-policy counted separately from
+# failures). One JSON line.
+bench-chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite chaos_slo
+
+# Deterministic chaos slice inside tier-1 time: the seeded fault-
+# injection / NodeKiller / shedding matrix cells (pytest -m chaos,
+# excluding the slow full-sweep cells), then the bench gate requiring
+# the chaos_slo SLO metric to be present and holding.
+chaos-gate:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos_matrix.py \
+		-q -m 'chaos and not slow'
+	$(PYTHON) scripts/check_bench.py \
+		--require chaos_slo.p99_ttft_under_kill
 
 # Regression gate over committed BENCH_pr*.json records: fails when the
 # newest record regresses >20% vs the previous one; required headline

@@ -1141,6 +1141,187 @@ def bench_llm_prefix(repeats=3):
     }
 
 
+def bench_chaos_slo(n_high=180, n_low=40, max_new=4):
+    """Config #12: the chaos × load SLO probe (PR 8). A many-hundred-
+    concurrent-stream load generator against a 2-replica LLM serving
+    deployment (the PR 5/7 engine behind Serve's streaming handle
+    plane) with TWO faults injected mid-load:
+
+    - OVERLOAD BY POLICY: the deployment runs priority admission
+      (max_ongoing_requests bound, nested class thresholds). n_high
+      class-0 streams RETRY on a typed RequestSheddedError (the 503 +
+      Retry-After client contract); n_low class-3 streams take one
+      shot and count shed-by-policy when refused — shed is recorded
+      SEPARATELY from failure.
+    - MID-LOAD KILL: once a third of the class-0 streams have their
+      first token, a seeded NodeKiller SIGKILLs one replica's worker
+      process. Streams on the victim surface typed errors and retry
+      onto the survivor / the controller's replacement replica.
+
+    Reported SLOs: p99 TTFT for class-0 streams — measured from each
+    stream's FIRST submit attempt, so shed-retry queueing delay and
+    kill-recovery latency are inside the number — and the effective
+    success rate (completions / (total - shed-by-policy)), asserted
+    >= 99%. `chaos_slo.p99_ttft_under_kill` is a required bench-gate
+    metric: the suite must run and record it on every future record."""
+    import os
+    import threading
+
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.exceptions import RequestSheddedError
+    from ray_tpu.llm import EngineConfig
+    from ray_tpu.llm.api import build_llm_app
+    from ray_tpu.models import TransformerConfig
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start()
+    mcfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=64, dtype=jnp.float32)
+    ecfg = EngineConfig(
+        model=mcfg, num_blocks=512, block_size=8, max_num_seqs=8,
+        prefill_token_budget=256, max_queued_requests=512,
+        max_new_tokens_default=max_new)
+    max_ongoing = 48
+    app = build_llm_app(ecfg, name="chaos_llm", num_replicas=2,
+                        max_ongoing_requests=max_ongoing)
+    handle = serve.run(app)
+    rng = __import__("random").Random(0)
+
+    def prompt(i):
+        return [1 + (7 * i + j) % 127 for j in range(16)]
+
+    # Warm both replicas' jit buckets + the stream plane out of the
+    # timed region (pow-2 routing spreads the warm streams).
+    for i in range(8):
+        assert len(list(handle.options(stream=True).remote(
+            {"prompt": prompt(i), "max_new_tokens": max_new}))) == max_new
+
+    first_tokens = 0
+    counters_lock = threading.Lock()
+    kill_gate = threading.Event()
+    results = []  # (cls, outcome, ttft_or_None)
+    deadline = time.monotonic() + 240.0
+
+    def run_stream(i, cls):
+        nonlocal first_tokens
+        req = {"prompt": prompt(1000 + i), "max_new_tokens": max_new,
+               "priority": cls}
+        t0 = time.perf_counter()
+        attempts = 0
+        while time.monotonic() < deadline:
+            attempts += 1
+            try:
+                gen = handle.options(stream=True,
+                                     priority=cls).remote(req)
+                toks = []
+                for tok in gen:
+                    if not toks:
+                        ttft = time.perf_counter() - t0
+                        with counters_lock:
+                            first_tokens += 1
+                            if first_tokens >= n_high // 3:
+                                kill_gate.set()
+                    toks.append(tok)
+                if len(toks) == max_new:
+                    results.append((cls, "ok", ttft))
+                    return
+                # Truncated stream (mid-kill): retry like a client would.
+            except RequestSheddedError as exc:
+                if cls != 0:
+                    results.append((cls, "shed", None))
+                    return  # low class takes the shed: that IS the policy
+                time.sleep(min(exc.retry_after_s, 0.5)
+                           * (0.5 + rng.random()))
+            except Exception:  # noqa: BLE001 — typed kill fallout: retry
+                time.sleep(0.1 * (0.5 + rng.random()))
+        results.append((cls, "timeout", None))
+
+    from ray_tpu.util import chaos as chaos_util
+
+    ctl = serve.api.get_or_create_controller()
+
+    def victim_pid():
+        info = ctl._deployments["chaos_llm"]
+        for r in info.replicas:
+            pid = r._runtime.pid
+            if pid and pid != os.getpid():
+                return pid
+        return None
+
+    killer = chaos_util.NodeKiller(
+        [chaos_util.pid_kill_target("chaos_llm_replica", victim_pid,
+                                    kind="worker", once=True)],
+        seed=8, interval_s=(0.01, 0.05), max_kills=1)
+
+    def arm_killer():
+        if kill_gate.wait(timeout=180):
+            killer.start()
+
+    armer = threading.Thread(target=arm_killer, daemon=True)
+    armer.start()
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=run_stream, args=(i, 0),
+                                daemon=True) for i in range(n_high)]
+    threads += [threading.Thread(target=run_stream, args=(i, 3),
+                                 daemon=True) for i in range(n_low)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    wall = time.perf_counter() - t_start
+    killer.stop()
+    kills = [k for k in killer.kills if "error" not in k]
+    assert kills, "the mid-load replica kill never fired"
+    assert not any(t.is_alive() for t in threads), "a stream hung"
+
+    ok_high = sorted(t for c, o, t in results if c == 0 and o == "ok")
+    ok_low = [1 for c, o, _ in results if c == 3 and o == "ok"]
+    shed_low = [1 for c, o, _ in results if c == 3 and o == "shed"]
+    failed = [(c, o) for c, o, _ in results if o == "timeout"]
+    total = n_high + n_low
+    effective_denom = total - len(shed_low)
+    success = (len(ok_high) + len(ok_low)) / max(effective_denom, 1)
+    assert success >= 0.99, (
+        f"effective success {success:.3f} < 0.99 "
+        f"(failed={failed}, shed={len(shed_low)})")
+    assert len(ok_high) == n_high, \
+        f"class-0 streams lost under kill: {len(ok_high)}/{n_high}"
+
+    admission = serve.status()["chaos_llm"]["admission"]
+    p99 = ok_high[min(len(ok_high) - 1, int(len(ok_high) * 0.99))]
+    p50 = ok_high[len(ok_high) // 2]
+    total_tokens = (len(ok_high) + len(ok_low)) * max_new
+    serve.shutdown()
+    return {
+        "suite": "chaos_slo",
+        "n_streams_high": n_high,
+        "n_streams_low": n_low,
+        "max_new_tokens": max_new,
+        "max_ongoing_requests": max_ongoing,
+        "replicas": 2,
+        "kills": kills,
+        "p99_ttft_under_kill": p99,
+        "p50_ttft_under_kill": p50,
+        "effective_success_rate": success,
+        "completed_high": len(ok_high),
+        "completed_low": len(ok_low),
+        "shed_by_policy": len(shed_low),
+        "failed": len(failed),
+        "streamed_tokens_per_sec": total_tokens / wall,
+        "wall_s": wall,
+        "serve_admission": admission,
+        "timing": ("in-process walls, CPU backend, process-backed "
+                   "replicas, warmed jit buckets; TTFT from first "
+                   "submit attempt (shed-retries and kill recovery "
+                   "included); one replica SIGKILLed after 1/3 of "
+                   "class-0 first tokens"),
+    }
+
+
 def bench_rl_rollout(repeats=6):
     """Config #5: PPO rollout collection, CartPole, 64 vectorized envs.
     Marginal-timed via fresh-process probes (honest-timing note at
@@ -1363,7 +1544,7 @@ def main():
     parser.add_argument("--suite", choices=[
         "chain", "fanout", "actor", "data", "rl", "model", "sharded",
         "control_plane", "workflow", "streaming", "llm_serving",
-        "llm_prefix"],
+        "llm_prefix", "chaos_slo"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -1388,6 +1569,7 @@ def main():
         "streaming": bench_streaming,
         "llm_serving": bench_llm_serving,
         "llm_prefix": bench_llm_prefix,
+        "chaos_slo": bench_chaos_slo,
     }
 
     if args.suite:

@@ -178,6 +178,20 @@ _D("generator_backpressure_items", int, 0,
    "tasks: the producer's yield loop pauses while this many committed "
    "items remain unconsumed, resuming on consumption acks "
    "(RAY_TPU_GENERATOR_BACKPRESSURE_ITEMS; 0 = unlimited).")
+_D("transport_handshake_timeout_s", float, 5.0,
+   "Server-side bound on the transport HMAC handshake: a connect-then-"
+   "hang or half-open peer is dropped after this many seconds instead "
+   "of pinning its handshake thread (the accept loop itself is never "
+   "blocked — handshakes run per-connection).")
+_D("peer_pull_attempts", int, 3,
+   "Direct peer chunk pulls retry (re-dialing a fresh lane) up to this "
+   "many times with jittered exponential backoff before the puller "
+   "gives up on the peer and falls back / declares the object lost — "
+   "bounded reconnect under chaos-induced resets.")
+_D("peer_pull_backoff_s", float, 0.05,
+   "Base backoff between peer pull attempts (doubled per attempt, "
+   "jittered x0.5-1.5 so synchronized pullers don't stampede a "
+   "recovering peer).")
 _D("worker_channel_bytes", int, 1024 * 1024,
    "Request/reply channel buffer size per worker process (4 channels per "
    "worker are resident in the shm store; larger blobs are staged as "

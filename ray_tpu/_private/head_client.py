@@ -207,7 +207,7 @@ class HeadClient:
         last: Optional[Exception] = None
         for addr in ordered:
             try:
-                conn = connect(*addr, self.token, timeout=5.0)
+                conn = connect(*addr, self.token, timeout=5.0, site="head")
                 conn.send(("hello", self.client_id, role))
                 self._check(conn.recv())
                 self.address = addr
@@ -451,7 +451,7 @@ class HeadClient:
         head-relayed bounded chunks otherwise."""
         located = self._request(("object_locate", oid_bin))
         if located and located.get("addr"):
-            raw = self._peers.pull(tuple(located["addr"]), oid_bin)
+            raw = self._peers.pull_retrying(tuple(located["addr"]), oid_bin)
             if raw is not None:
                 self.direct_pulls += 1
                 return raw

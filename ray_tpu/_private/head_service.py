@@ -298,7 +298,7 @@ class HeadService:
     def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
                  token: Optional[str] = None,
                  state_path: Optional[str] = None):
-        self._listener = TokenListener(host, port, None)
+        self._listener = TokenListener(host, port, None, site="head")
         self.host, self.port = self._listener.address
         # Token resolution order: explicit > env > this port's existing
         # token file (a restarted head MUST keep its token or surviving

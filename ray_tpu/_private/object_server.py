@@ -51,7 +51,7 @@ class ObjectServer:
                  token: str, advertise_host: str = "127.0.0.1"):
         self._provider = bytes_provider
         self.handlers: Dict[str, Callable[[tuple], object]] = {}
-        self._listener = TokenListener("0.0.0.0", 0, token)
+        self._listener = TokenListener("0.0.0.0", 0, token, site="object")
         self.address: Tuple[str, int] = (
             advertise_host, self._listener.address[1])
         self._stop = False
@@ -141,6 +141,11 @@ class PeerPool:
         self._lanes: Dict[Tuple[str, int], list] = {}  # addr -> [_PeerLane]
         self._rr: Dict[Tuple[str, int], int] = {}  # busy-lane rotation
         self._lock = threading.Lock()
+        # Bounded-reconnect accounting (chaos/observability): every
+        # failed attempt that was retried, and every pull that exhausted
+        # its attempt budget without bytes.
+        self.pull_retries = 0
+        self.pull_exhausted = 0
 
     def _get(self, addr: Tuple[str, int]) -> _PeerLane:
         """An idle lane when one exists; otherwise a fresh lane (up to
@@ -155,7 +160,7 @@ class PeerPool:
                 self._rr[addr] = (self._rr.get(addr, 0) + 1) % len(lanes)
                 return lanes[self._rr[addr]]
         lane = _PeerLane(connect(addr[0], addr[1], self._token,
-                                 timeout=5.0))
+                                 timeout=5.0, site="peer"))
         with self._lock:
             lanes = self._lanes.setdefault(addr, [])
             if len(lanes) < self._LANES:
@@ -190,6 +195,14 @@ class PeerPool:
         chunk. None on any failure (caller falls back to the
         head-relayed path); a failure mid-window poisons the connection
         (unread replies), so it is dropped and redialed next use."""
+        return self._pull_attempt(addr, oid_bin)[1]
+
+    def _pull_attempt(self, addr: Tuple[str, int], oid_bin: bytes
+                      ) -> Tuple[str, Optional[bytes]]:
+        """One pull attempt, with the outcome distinguished so bounded
+        reconnect only retries what retrying can fix: ``("data", bytes)``,
+        ``("absent", None)`` — the peer answered and does NOT serve the
+        object — or ``("error", None)`` — transport-level failure."""
         for _ in range(2):  # one fresh-lane retry after a dead pick
             lane = None
             try:
@@ -199,14 +212,16 @@ class PeerPool:
                         self._drop(addr, lane)
                         continue  # its poisoner is retiring it
                     try:
-                        return self._pull_on_lane(lane.conn, oid_bin)
+                        raw = self._pull_on_lane(lane.conn, oid_bin)
                     except Exception:
                         lane.dead = True  # set UNDER the lock
                         raise
+                    return ("data", raw) if raw is not None \
+                        else ("absent", None)
             except Exception:  # noqa: BLE001 — peer gone / poisoned lane
                 self._drop(addr, lane)
-                return None
-        return None
+                return ("error", None)
+        return ("error", None)
 
     @staticmethod
     def _pull_on_lane(conn: FramedConnection,
@@ -235,6 +250,36 @@ class PeerPool:
         if len(data) != size:
             raise ConnectionError("object re-announced mid-pull")
         return data
+
+    def pull_retrying(self, addr: Tuple[str, int], oid_bin: bytes,
+                      attempts: Optional[int] = None) -> Optional[bytes]:
+        """``pull`` with a BOUNDED jittered-backoff reconnect loop: a
+        peer resetting connections (chaos, restart-in-progress, flaky
+        network) gets ``peer_pull_attempts`` fresh dials with
+        exponential backoff (x0.5–1.5 jitter so concurrent pullers
+        don't stampede), then the puller gives up — callers fall back
+        to the head relay and, when that also fails for an object
+        nothing can rebuild, materialize a typed ``ObjectLostError``
+        instead of retrying forever."""
+        import random
+        import time
+
+        from ray_tpu._private.config import GlobalConfig
+
+        if attempts is None:
+            attempts = max(1, int(GlobalConfig.peer_pull_attempts))
+        base = float(GlobalConfig.peer_pull_backoff_s)
+        for i in range(attempts):
+            status, raw = self._pull_attempt(addr, oid_bin)
+            if status == "data":
+                return raw
+            if status == "absent":
+                return None  # authoritative answer: retrying can't help
+            if i + 1 < attempts:
+                self.pull_retries += 1
+                time.sleep(base * (2 ** i) * (0.5 + random.random()))
+        self.pull_exhausted += 1
+        return None
 
     def call_many(self, addr: Tuple[str, int], msgs: list) -> list:
         """Batched request/response against a peer's registered handlers:

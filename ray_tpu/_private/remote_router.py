@@ -1279,6 +1279,32 @@ class RemoteRouter:
             if ev is not None and ev.is_set():
                 with self._lock:
                     external = tid in self.external
+                    has_lineage = tid in self.lineage
+                if not external and not has_lineage:
+                    # Completed, owner can't serve the bytes, and there
+                    # is no lineage spec to re-execute (lineage pinning
+                    # off / spec dropped): unbounded pull retries can
+                    # never converge — bound them like the external
+                    # case and materialize a typed loss. Chaos-induced
+                    # connection resets land here instead of spinning.
+                    if external_deadline is None:
+                        external_deadline = (
+                            time.monotonic()
+                            + GlobalConfig.external_pull_ttl_s)
+                    elif time.monotonic() > external_deadline:
+                        self.worker.store.put_error(
+                            object_id, ObjectLostError(
+                                f"object {object_id.hex()[:16]}… "
+                                f"completed but its bytes are no longer "
+                                f"served by any node and no lineage is "
+                                f"pinned to reconstruct it"))
+                        return
+                    if self._stop.wait(backoff):
+                        return  # router shutting down
+                    # Jittered exponential backoff: concurrent pullers
+                    # must not stampede a recovering owner in lockstep.
+                    backoff = min(backoff * 2, 1.0)
+                    continue
                 if external:
                     # Actor-task result: never re-executed. The hosting
                     # node may still be serializing — retry with backoff;

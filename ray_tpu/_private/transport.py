@@ -47,6 +47,12 @@ import msgpack
 MAX_FRAME = 1 << 30  # 1 GiB: chunked pulls should keep frames far below this
 _LEN = struct.Struct(">I")
 
+# Chaos fault injection slot (ray_tpu._private.chaos.install sets it; the
+# RAY_TPU_CHAOS env var installs at import, see bottom of module). With
+# chaos off this stays None and the send paths pay ONE global load +
+# `is None` branch — no RNG, no counters, no allocation. Provably inert.
+_CHAOS = None
+
 # Scatter-gather writes are chunked to stay under the kernel's iovec
 # limit (UIO_MAXIOV is 1024 on Linux; each frame is 2 buffers).
 _IOV_FRAMES = 256
@@ -162,6 +168,9 @@ class FramedConnection:
         self._closed = False
         self._hdr = bytearray(4)  # reused header recv buffer
         self._rbuf = bytearray(64 * 1024)  # reused payload recv buffer
+        # Coarse plane label for chaos-injection scoping ("head", "peer",
+        # "object", ...); owners overwrite it right after construction.
+        self.site = "conn"
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     # raw framing -----------------------------------------------------------
@@ -187,6 +196,13 @@ class FramedConnection:
         n = len(payload)
         if n > MAX_FRAME:
             raise ValueError(f"frame too large: {n}")
+        if _CHAOS is not None:
+            faulted = _CHAOS.on_send(self, payload)  # may sleep / raise
+            if faulted is not None:
+                with self._sendlock:
+                    for p in faulted:
+                        self._send_buffers_locked([_LEN.pack(len(p)), p])
+                return
         with self._sendlock:
             self._send_buffers_locked([_LEN.pack(n), payload])
 
@@ -196,6 +212,12 @@ class FramedConnection:
         for p in payloads:
             if len(p) > MAX_FRAME:
                 raise ValueError(f"frame too large: {len(p)}")
+        if _CHAOS is not None:
+            out = []
+            for p in payloads:
+                faulted = _CHAOS.on_send(self, p)  # may sleep / raise
+                out.extend(faulted if faulted is not None else [p])
+            payloads = out
         with self._sendlock:
             for i in range(0, len(payloads), _IOV_FRAMES):
                 bufs = []
@@ -294,6 +316,19 @@ def read_token_file(port: int) -> Optional[str]:
         return None
 
 
+def handshake_timeout_s() -> float:
+    """Server-side bound on the HMAC challenge-response exchange: a
+    connect-then-hang (or half-open) peer is cut off after this many
+    seconds instead of pinning its handshake thread forever
+    (RAY_TPU_TRANSPORT_HANDSHAKE_TIMEOUT_S)."""
+    try:
+        from ray_tpu._private.config import GlobalConfig
+
+        return float(GlobalConfig.transport_handshake_timeout_s)
+    except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+        return 5.0
+
+
 class TokenListener:
     """Server side: accept() returns connections that passed the HMAC
     challenge-response handshake. Failed handshakes are dropped. The
@@ -301,13 +336,17 @@ class TokenListener:
     to learn its port, then resolves the cluster token for that port."""
 
     def __init__(self, host: str, port: int, token: Optional[str],
-                 backlog: int = 64):
+                 backlog: int = 64, site: str = "conn"):
         self._token = token
+        self.site = site  # chaos-injection label for accepted conns
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(backlog)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._ready = None  # lazily-started accept() plumbing
+        self._accept_thread = None
+        self._accept_init_lock = threading.Lock()
 
     def set_token(self, token: str):
         self._token = token
@@ -315,24 +354,57 @@ class TokenListener:
     def accept_raw(self) -> FramedConnection:
         """Accept WITHOUT the handshake — run ``server_handshake`` in a
         per-connection thread, so one slow or unauthenticated peer cannot
-        stall the accept loop for its 5s handshake timeout."""
+        stall the accept loop for its handshake timeout."""
         sock, _ = self._sock.accept()
-        return FramedConnection(sock)
+        conn = FramedConnection(sock)
+        conn.site = self.site
+        return conn
 
     def server_handshake(self, conn: FramedConnection):
         sock = conn._sock
-        sock.settimeout(5.0)
+        sock.settimeout(handshake_timeout_s())
         _server_handshake(conn, self._token)
         sock.settimeout(None)
 
     def accept(self) -> FramedConnection:
+        """One authenticated connection. Handshakes run on per-connection
+        threads feeding an internal ready queue, so a connect-then-hang
+        client can never wedge the accept loop: a later well-behaved peer
+        is admitted while the stalled one is still inside its (bounded)
+        handshake timeout. Raises OSError once the listener is closed."""
+        import queue as _queue
+
+        with self._accept_init_lock:
+            if self._ready is None:
+                self._ready = _queue.Queue()
+                self._accept_thread = threading.Thread(
+                    target=self._accept_pump, daemon=True,
+                    name="ray_tpu_accept_pump")
+                self._accept_thread.start()
+        conn = self._ready.get()
+        if conn is None:
+            self._ready.put(None)  # wake any other accept() waiter too
+            raise OSError("listener closed")
+        return conn
+
+    def _accept_pump(self):
         while True:
-            conn = self.accept_raw()
             try:
-                self.server_handshake(conn)
-                return conn
-            except Exception:  # noqa: BLE001 — unauthenticated peer
-                conn.close()
+                conn = self.accept_raw()
+            except OSError:
+                self._ready.put(None)
+                return
+
+            def _handshake(conn=conn):
+                try:
+                    self.server_handshake(conn)
+                except Exception:  # noqa: BLE001 — unauthenticated/stalled
+                    conn.close()
+                    return
+                self._ready.put(conn)
+
+            threading.Thread(target=_handshake, daemon=True,
+                             name="ray_tpu_handshake").start()
 
     def close(self):
         host = port = None
@@ -363,9 +435,10 @@ class TokenListener:
 
 
 def connect(host: str, port: int, token: str,
-            timeout: float = 10.0) -> FramedConnection:
+            timeout: float = 10.0, site: str = "conn") -> FramedConnection:
     sock = socket.create_connection((host, port), timeout=timeout)
     conn = FramedConnection(sock)
+    conn.site = site
     try:
         _client_handshake(conn, token)
     except Exception:
@@ -373,3 +446,17 @@ def connect(host: str, port: int, token: str,
         raise
     sock.settimeout(None)
     return conn
+
+
+# RAY_TPU_CHAOS in the environment activates wire-fault injection for
+# this process (and, because env vars inherit, every daemon/worker it
+# spawns). Parsed once at import; programmatic install/uninstall via
+# ray_tpu._private.chaos (ray_tpu.util.chaos) overrides it.
+if os.environ.get("RAY_TPU_CHAOS"):
+    def _bootstrap_chaos():
+        from ray_tpu._private.chaos import install_from_env
+
+        install_from_env()
+
+    _bootstrap_chaos()
+    del _bootstrap_chaos

@@ -12,6 +12,9 @@ Endpoints:
 - ``GET /api/llm``      live inference-engine counters (scheduler
   parks/preemptions, block occupancy, prefix-cache hit rate and
   prefill-tokens-saved — cache effectiveness, live)
+- ``GET /api/chaos``    chaos + overload panel: injected wire-fault
+  counters per site, NodeKiller kill log, and load-shedding /
+  priority-admission stats from serve deployments and LLM engines
 """
 
 from __future__ import annotations
@@ -45,6 +48,7 @@ async function refresh() {
     '<h2>object store</h2>' + table(s.object_store) +
     '<h2>workflows</h2>' + table(s.workflows) +
     '<h2>llm engines</h2>' + table(s.llm) +
+    '<h2>chaos & shedding</h2>' + table(s.chaos) +
     '<h2>workers</h2>' + table(s.workers);
 }
 refresh(); setInterval(refresh, 2000);
@@ -81,6 +85,7 @@ def _snapshot() -> dict:
         },
         "workflows": _workflow_summary(),
         "llm": _llm_summary(),
+        "chaos": _chaos_summary(),
         "workers": {
             "mode": w.worker_mode,
             "pool_size": pool.size if pool is not None else 0,
@@ -133,6 +138,17 @@ def _llm_summary() -> dict:
         return {"error": repr(exc)}
 
 
+def _chaos_summary() -> dict:
+    """Chaos + shedding panel: injected-fault counters, kill log size,
+    shed/admission stats (all-zero when chaos never ran)."""
+    try:
+        from ray_tpu.util.state import chaos_summary
+
+        return chaos_summary()
+    except Exception as exc:  # noqa: BLE001 — panel must not kill page
+        return {"error": repr(exc)}
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
@@ -167,6 +183,12 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = json.dumps(
                     [e.__dict__ for e in list_llm_engines(limit=100)],
                     default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/chaos"):
+                from ray_tpu.util.state import chaos_summary
+
+                payload = json.dumps(chaos_summary(),
+                                     default=str).encode()
                 ctype = "application/json"
             else:
                 payload = _PAGE.encode()

@@ -65,6 +65,14 @@ class RayTaskError(RayTpuError):
             # Double wrap (a stage re-wrapped an already-typed remote
             # error): surface the innermost original type.
             return self.cause.as_instanceof_cause()
+        if issubclass(cause_cls, RequestSheddedError):
+            # Shed-by-policy must stay matchable (`except
+            # RequestSheddedError`) and keep its priority/retry_after_s
+            # even when the shed happened inside a process-backed
+            # replica and crossed the wire wrapped as a task error —
+            # overload is policy, not a task failure, so the client
+            # retry contract depends on the exact type surviving.
+            return self.cause
         if issubclass(cause_cls, RayTpuError):
             return self
         try:
@@ -141,6 +149,23 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PendingCallsLimitExceededError(RayTpuError):
     pass
+
+
+class RequestSheddedError(RayTpuError):
+    """The request was refused (or evicted pre-admission) by the load-
+    shedding policy under overload — NOT a failure of the request
+    itself. Retryable after ``retry_after_s``; the HTTP proxy maps it
+    to 503 + Retry-After. ``priority`` is the shed request's class
+    (0 = most important; higher classes shed first)."""
+
+    def __init__(self, message: str = "", priority: int = 0,
+                 retry_after_s: float = 1.0):
+        self.priority = priority
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            message or f"request (priority class {priority}) shed by "
+                       f"load-shedding policy; retry after "
+                       f"{retry_after_s:.1f}s")
 
 
 class ChannelError(RayTpuError):

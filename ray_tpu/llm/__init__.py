@@ -7,9 +7,11 @@ automatic prefix caching, chunked prefill, tensor-parallel decode).
   device arrays, per-sequence block tables, refcounted copy-on-write
   SHARED PREFIX BLOCKS (chain-hashed full blocks; a prompt whose prefix
   is cached skips that prefill entirely), cached-free LRU tier.
-- ``Scheduler`` (scheduler.py): bounded-waitqueue admission, CHUNKED
-  prefill under the per-iteration token budget (a long prompt can't
-  stall the batch), recompute eviction on KV OOM.
+- ``Scheduler`` (scheduler.py): bounded-waitqueue admission in
+  (priority, FIFO) order with LOAD SHEDDING — at capacity the worst
+  class is evicted/refused with a typed ``RequestSheddedError`` —
+  CHUNKED prefill under the per-iteration token budget (a long prompt
+  can't stall the batch), recompute eviction on KV OOM.
 - ``InferenceEngine`` (engine.py): jitted chunk-prefill/decode step
   loop with streaming per-request token queues; ``tp_size`` shards the
   model and the KV pool (along ``n_kv_heads``) across the mesh.

@@ -40,8 +40,11 @@ class LLMServer:
 
     A request is either a token list (``[1, 2, 3]``) or a dict
     ``{"prompt": [...], "max_new_tokens": n, "temperature": t,
-    "eos_token_id": e, "seed": s}``. Yields one int token id per
-    generated token.
+    "eos_token_id": e, "seed": s, "priority": p}``. Yields one int
+    token id per generated token. ``priority`` (0 = most important,
+    default) feeds the engine's load-shedding admission: under
+    overload the bounded waitqueue evicts the worst class with a typed
+    ``RequestSheddedError`` instead of timing everyone out.
     """
 
     def __init__(self, engine_config: Optional[EngineConfig] = None,
@@ -54,7 +57,7 @@ class LLMServer:
             prompt = request["prompt"]
             kwargs = {k: request[k] for k in
                       ("max_new_tokens", "eos_token_id", "temperature",
-                       "seed") if k in request}
+                       "seed", "priority") if k in request}
         else:
             prompt, kwargs = request, {}
         # A cancelled stream raises GeneratorExit through here; the
@@ -87,6 +90,7 @@ class LLMServer:
 def build_llm_app(engine_config: Optional[EngineConfig] = None, *,
                   name: str = "llm", num_replicas: int = 1,
                   autoscaling_config: Optional[dict] = None,
+                  max_ongoing_requests: Optional[int] = None,
                   params: Optional[dict] = None):
     """Build a Serve Application serving ``engine_config``.
 
@@ -96,10 +100,16 @@ def build_llm_app(engine_config: Optional[EngineConfig] = None, *,
     the deployment args. Deploy with ``serve.run(app)`` and stream via
     ``handle.options(stream=True).remote({...})`` or
     ``POST /<name>?stream=1``.
+
+    ``max_ongoing_requests`` bounds total in-flight requests across the
+    deployment (priority admission: lower classes shed first with a
+    typed ``RequestSheddedError`` / HTTP 503 + Retry-After); request
+    ``priority`` rides the request dict.
     """
     from ray_tpu import serve
 
     dep = serve.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
-        autoscaling_config=autoscaling_config)
+        autoscaling_config=autoscaling_config,
+        max_ongoing_requests=max_ongoing_requests)
     return dep.bind(engine_config, params)

@@ -56,10 +56,12 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu.llm.kv_cache import KVCacheOOM, PagedKVCache  # noqa: F401
+from ray_tpu.exceptions import RequestSheddedError
 from ray_tpu.llm.scheduler import (
     CANCELLED,
     FAILED,
     FINISHED,
+    SHED,
     EngineQueueFull,
     Request,
     Scheduler,
@@ -273,17 +275,22 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0,
-               seed: Optional[int] = None) -> Request:
-        """Enqueue a request; raises EngineQueueFull past the bounded
-        waitqueue. Tokens arrive on ``req.output_queue`` as iterations
-        commit them."""
+               seed: Optional[int] = None,
+               priority: int = 0) -> Request:
+        """Enqueue a request. Past the bounded waitqueue the LOWEST
+        priority class loses: either this submit raises
+        ``EngineQueueFull`` (a ``RequestSheddedError``) or a worse
+        already-waiting request is evicted with a typed
+        ``RequestSheddedError`` on its stream — overload degrades by
+        policy, not by timeout. Tokens arrive on ``req.output_queue``
+        as iterations commit them."""
         req = Request(
             prompt,
             max_new_tokens if max_new_tokens is not None
             else self.config.max_new_tokens_default,
             eos_token_id=(eos_token_id if eos_token_id is not None
                           else self.config.eos_token_id),
-            temperature=temperature, seed=seed)
+            temperature=temperature, seed=seed, priority=priority)
         # Reject what can NEVER be served: a completion longer than the
         # model's context window, or one larger than the whole pool.
         # (Prompts over the prefill token budget are FINE — chunked
@@ -300,7 +307,15 @@ class InferenceEngine:
                 f"blocks for {total} tokens; pool holds "
                 f"{self.cache.usable_blocks}")
         with self._lock:
-            self.scheduler.submit(req)
+            victim = self.scheduler.submit(req)
+            if victim is not None:
+                # Evicted pre-admission (never held blocks): its consumer
+                # gets the typed shed error, counted apart from failures.
+                self._finish(victim, SHED, RequestSheddedError(
+                    f"request (priority class {victim.priority}) evicted "
+                    f"from the waitqueue by a class-{req.priority} "
+                    f"arrival under overload",
+                    priority=victim.priority))
             self._requests[req.seq_id] = req
             self._work.set()
         self._ensure_loop()
@@ -311,13 +326,15 @@ class InferenceEngine:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0,
                  seed: Optional[int] = None,
+                 priority: int = 0,
                  timeout_s: float = 120.0) -> Iterator[int]:
         """Streaming generator of token ids. Closing it mid-generation
         (``close()`` / GC / a Serve stream cancel) frees the sequence's
         private KV blocks immediately."""
         req = self.submit(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id,
-                          temperature=temperature, seed=seed)
+                          temperature=temperature, seed=seed,
+                          priority=priority)
         try:
             while True:
                 try:
@@ -353,7 +370,7 @@ class InferenceEngine:
                 error: Optional[BaseException] = None):
         self.scheduler.release(req, status, error)
         self._requests.pop(req.seq_id, None)
-        if status == FAILED and error is not None:
+        if status in (FAILED, SHED) and error is not None:
             req.output_queue.put((_ERROR, error))
         else:
             req.output_queue.put((_DONE, status))

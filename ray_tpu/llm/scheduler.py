@@ -38,23 +38,27 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.exceptions import RequestSheddedError
 from ray_tpu.llm.kv_cache import PagedKVCache
 
 __all__ = ["EngineQueueFull", "Request", "Scheduler",
-           "WAITING", "RUNNING", "FINISHED", "CANCELLED", "FAILED"]
+           "WAITING", "RUNNING", "FINISHED", "CANCELLED", "FAILED", "SHED"]
 
 WAITING = "WAITING"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 CANCELLED = "CANCELLED"
 FAILED = "FAILED"
+SHED = "SHED"  # evicted pre-admission by the load-shedding policy
 
 _seq_counter = itertools.count(1)
 
 
-class EngineQueueFull(RuntimeError):
-    """The bounded admission waitqueue is at capacity (backpressure —
-    callers should retry/shed, the engine never buffers unboundedly)."""
+class EngineQueueFull(RequestSheddedError, RuntimeError):
+    """The bounded admission waitqueue is at capacity and the incoming
+    request did not outrank anything waiting (backpressure — callers
+    should retry/shed; the engine never buffers unboundedly). A
+    ``RequestSheddedError``: overload is policy, not failure."""
 
 
 class Request:
@@ -63,7 +67,8 @@ class Request:
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 priority: int = 0):
         if not prompt:
             raise ValueError("empty prompt")
         self.seq_id = next(_seq_counter)
@@ -72,6 +77,9 @@ class Request:
         self.eos_token_id = eos_token_id
         self.temperature = float(temperature)
         self.seed = seed
+        # Admission class: 0 = most important. Under overload the
+        # waitqueue admits better classes first and sheds worse ones.
+        self.priority = int(priority)
         self.out_tokens: List[int] = []
         # Prompt tokens whose KV is in the cache (prefix-cache hits at
         # admission + chunks computed so far). The request decodes only
@@ -98,7 +106,7 @@ class Request:
         return self.prefill_pos < len(self.prompt)
 
     def finished(self) -> bool:
-        return self.status in (FINISHED, CANCELLED, FAILED)
+        return self.status in (FINISHED, CANCELLED, FAILED, SHED)
 
 
 class Scheduler:
@@ -122,15 +130,61 @@ class Scheduler:
         self.prefill_chunks_scheduled = 0
         self.max_prefill_tokens_per_step = 0  # chunked-prefill stall bound
         self.coscheduled_steps = 0  # iterations with BOTH chunks + decodes
+        # Load-shedding accounting ("shed-by-policy", distinct from
+        # failures): requests refused or evicted pre-admission when the
+        # bounded waitqueue overflowed, per priority class.
+        self.shed_requests = 0
+        self.shed_by_class: Dict[int, int] = {}
+        self.submitted_by_class: Dict[int, int] = {}
 
     # ------------------------------------------------------------ admission
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> Optional[Request]:
+        """Enqueue ``req`` in (priority, FIFO) order. At capacity the
+        LOWEST-priority waiting request loses: if something waiting is
+        strictly worse than the newcomer it is evicted and returned (the
+        caller fails it with a typed ``RequestSheddedError``); otherwise
+        the newcomer itself is shed by raising ``EngineQueueFull``.
+        Overload therefore degrades by policy — the best classes keep
+        their queue slots — instead of by arrival order."""
         with self._lock:
+            self.submitted_by_class[req.priority] = \
+                self.submitted_by_class.get(req.priority, 0) + 1
+            victim: Optional[Request] = None
             if len(self.waiting) >= self.max_queued_requests:
-                raise EngineQueueFull(
-                    f"waitqueue at capacity "
-                    f"({self.max_queued_requests} requests)")
-            self.waiting.append(req)
+                # Eviction candidates: requests that were never admitted
+                # (preemptions == 0). A recompute-preempted request is
+                # mid-generation — its consumer already holds streamed
+                # tokens — so shedding it would break the "shed happens
+                # pre-admission, retry is safe" contract.
+                candidates = [w for w in self.waiting
+                              if w.preemptions == 0]
+                worst = max(
+                    candidates,
+                    key=lambda w: (w.priority, w.seq_id), default=None)
+                if worst is None or worst.priority <= req.priority:
+                    self.shed_requests += 1
+                    self.shed_by_class[req.priority] = \
+                        self.shed_by_class.get(req.priority, 0) + 1
+                    raise EngineQueueFull(
+                        f"waitqueue at capacity "
+                        f"({self.max_queued_requests} requests) and no "
+                        f"waiting request has lower priority than "
+                        f"class {req.priority}",
+                        priority=req.priority)
+                self.waiting.remove(worst)
+                self.shed_requests += 1
+                self.shed_by_class[worst.priority] = \
+                    self.shed_by_class.get(worst.priority, 0) + 1
+                victim = worst
+            # Stable priority insert: behind every waiting request of an
+            # equal-or-better class (FIFO within a class).
+            idx = len(self.waiting)
+            for i, w in enumerate(self.waiting):
+                if w.priority > req.priority:
+                    idx = i
+                    break
+            self.waiting.insert(idx, req)
+            return victim
 
     def remove_waiting(self, req: Request) -> bool:
         with self._lock:
@@ -278,4 +332,7 @@ class Scheduler:
             "prefill_chunks_scheduled": self.prefill_chunks_scheduled,
             "max_prefill_tokens_per_step": self.max_prefill_tokens_per_step,
             "coscheduled_steps": self.coscheduled_steps,
+            "shed_requests": self.shed_requests,
+            "shed_by_class": dict(self.shed_by_class),
+            "submitted_by_class": dict(self.submitted_by_class),
         }

@@ -31,16 +31,23 @@ class Application:
 class Deployment:
     def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
                  autoscaling_config: Optional[dict] = None,
+                 max_ongoing_requests: Optional[int] = None,
                  **_opts):
         self._target = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
         self.autoscaling_config = autoscaling_config
+        # Priority admission + load shedding: total in-flight bound
+        # across the deployment's replicas (None = unlimited). Requests
+        # past their priority class's nested threshold are refused with
+        # a typed RequestSheddedError (HTTP: 503 + Retry-After).
+        self.max_ongoing_requests = max_ongoing_requests
 
     def options(self, **opts) -> "Deployment":
         merged = dict(
             name=self.name, num_replicas=self.num_replicas,
-            autoscaling_config=self.autoscaling_config)
+            autoscaling_config=self.autoscaling_config,
+            max_ongoing_requests=self.max_ongoing_requests)
         merged.update(opts)
         return Deployment(self._target, **merged)
 
@@ -50,7 +57,8 @@ class Deployment:
 
 def deployment(_cls=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
-               autoscaling_config: Optional[dict] = None, **opts):
+               autoscaling_config: Optional[dict] = None,
+               max_ongoing_requests: Optional[int] = None, **opts):
     """@serve.deployment decorator for classes or functions."""
 
     def wrap(cls):
@@ -68,7 +76,8 @@ def deployment(_cls=None, *, name: Optional[str] = None,
         return Deployment(
             target, name or getattr(cls, "__name__", "deployment"),
             num_replicas=num_replicas,
-            autoscaling_config=autoscaling_config, **opts)
+            autoscaling_config=autoscaling_config,
+            max_ongoing_requests=max_ongoing_requests, **opts)
 
     return wrap(_cls) if _cls is not None else wrap
 
@@ -90,7 +99,8 @@ def _deploy_app(app: Application) -> DeploymentHandle:
     if d.autoscaling_config:
         auto = AutoscalingConfig(**d.autoscaling_config)
     controller.deploy(d.name, d._target, args, kwargs,
-                      num_replicas=d.num_replicas, autoscaling=auto)
+                      num_replicas=d.num_replicas, autoscaling=auto,
+                      max_ongoing_requests=d.max_ongoing_requests)
     return DeploymentHandle(d.name, controller)
 
 

@@ -35,6 +35,7 @@ class DeploymentInfo:
     init_kwargs: dict
     num_replicas: int
     autoscaling: Optional[AutoscalingConfig]
+    max_ongoing_requests: Optional[int] = None
     replicas: List[Any] = field(default_factory=list)
     replica_set: ReplicaSet = field(default_factory=ReplicaSet)
     status: str = "UPDATING"
@@ -67,16 +68,19 @@ class ServeController:
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, cls: type, init_args, init_kwargs,
                num_replicas: int,
-               autoscaling: Optional[AutoscalingConfig]) -> None:
+               autoscaling: Optional[AutoscalingConfig],
+               max_ongoing_requests: Optional[int] = None) -> None:
         with self._lock:
             old = self._deployments.get(name)
             info = DeploymentInfo(
                 name=name, cls=cls, init_args=init_args,
                 init_kwargs=init_kwargs, num_replicas=num_replicas,
-                autoscaling=autoscaling)
+                autoscaling=autoscaling,
+                max_ongoing_requests=max_ongoing_requests)
             if old is not None:
                 info.replicas = old.replicas
                 info.replica_set = old.replica_set
+            info.replica_set.configure_admission(max_ongoing_requests)
             self._deployments[name] = info
         self._reconcile_once()
 
@@ -330,6 +334,7 @@ class ServeController:
                     "target_replicas": info.num_replicas,
                     "requests": info.request_count,
                     "queue_lengths": info.replica_set.queue_lengths(),
+                    "admission": info.replica_set.admission_stats(),
                 }
                 for name, info in self._deployments.items()
             }

@@ -226,13 +226,19 @@ class _DetachedRouter:
     instead of the driver-local controller. Autoscaling changes after the
     snapshot are not observed (reference parity: handles cache their
     replica set and refresh from the controller; the refresh channel here
-    is re-sending the handle)."""
+    is re-sending the handle). The deployment's admission config rides
+    the snapshot too, enforced PER HANDLE-HOLDING PROCESS: in-flight
+    counts aren't shared with the driver-side router (same caveat as the
+    replica snapshot), so the bound is per caller, not global."""
 
-    def __init__(self, replicas):
+    def __init__(self, replicas, admission=None):
         from ray_tpu.serve.router import ReplicaSet
 
         self._rs = ReplicaSet()
         self._rs.update(list(replicas))
+        if admission:
+            self._rs.configure_admission(admission.get("max_ongoing"),
+                                         admission.get("fractions"))
 
     def _replica_set(self, name):
         return self._rs
@@ -241,12 +247,14 @@ class _DetachedRouter:
         pass
 
 
-def _rebuild_deployment_handle(name, method, stream, replicas):
+def _rebuild_deployment_handle(name, method, stream, replicas,
+                               priority=0, admission=None):
     handle = DeploymentHandle.__new__(DeploymentHandle)
     handle._name = name
-    handle._controller = _DetachedRouter(replicas)
+    handle._controller = _DetachedRouter(replicas, admission=admission)
     handle._method = method
     handle._stream = stream
+    handle._priority = priority
     return handle
 
 
@@ -266,24 +274,30 @@ def _extract_prefix_tokens(args, kwargs):
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 priority: int = 0):
         self._name = deployment_name
         self._controller = controller
         self._method = method_name
         self._stream = stream
+        self._priority = priority
 
     def __reduce__(self):
         rs = self._controller._replica_set(self._name)
+        admission = {"max_ongoing": rs._max_ongoing,
+                     "fractions": list(rs._class_fractions)}
         return (_rebuild_deployment_handle,
                 (self._name, self._method, self._stream,
-                 list(rs._replicas)))
+                 list(rs._replicas), self._priority, admission))
 
     def options(self, method_name: Optional[str] = None, *,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                priority: Optional[int] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._name, self._controller,
             method_name if method_name is not None else self._method,
-            stream=self._stream if stream is None else stream)
+            stream=self._stream if stream is None else stream,
+            priority=self._priority if priority is None else int(priority))
 
     def remote(self, *args, **kwargs):
         rs = self._controller._replica_set(self._name)
@@ -294,7 +308,11 @@ class DeploymentHandle:
         prefix_tokens = None
         if rs.has_prefix_digests():
             prefix_tokens = _extract_prefix_tokens(args, kwargs)
-        key, replica = rs.choose(prefix_tokens=prefix_tokens)
+        # Priority admission: past the deployment's class threshold this
+        # raises a typed RequestSheddedError before any replica is
+        # touched — overload degrades by policy, not by timeout.
+        key, replica = rs.choose(prefix_tokens=prefix_tokens,
+                                 priority=self._priority)
         # Chain: unwrap DeploymentResponses into ObjectRefs so downstream
         # deployments receive resolved values without blocking here.
         args = tuple(

@@ -9,10 +9,12 @@ the same pow-2 router as handle calls.
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ray_tpu.exceptions import RequestSheddedError
 from ray_tpu.serve.controller import get_or_create_controller
 from ray_tpu.serve.handle import DeploymentHandle
 
@@ -70,11 +72,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(payload)
             return
-        stream = parse_qs(parsed.query).get(
-            "stream", ["0"])[0] in ("1", "true")
+        qs = parse_qs(parsed.query)
+        stream = qs.get("stream", ["0"])[0] in ("1", "true")
+        # Priority class for admission/shedding: the X-Request-Priority
+        # header or ?priority= (0 = most important, the default).
+        try:
+            priority = int(self.headers.get(
+                "X-Request-Priority", qs.get("priority", ["0"])[0]))
+        except (TypeError, ValueError):
+            priority = 0
         try:
             arg = json.loads(body) if body else None
-            handle = DeploymentHandle(name, controller)
+            handle = DeploymentHandle(name, controller,
+                                      priority=priority)
             if stream:
                 # Chunked transfer: one JSON line per generator item, sent
                 # as the replica yields (reference: streaming responses
@@ -119,6 +129,19 @@ class _Handler(BaseHTTPRequestHandler):
             payload = json.dumps({"error": f"no deployment {name!r}"}
                                  ).encode()
             self.send_response(404)
+        except RequestSheddedError as exc:
+            # Shed by the admission policy (choose() raises before any
+            # replica is touched, so for streams too this lands before
+            # headers went out): 503 + Retry-After — the client-visible
+            # contract that overload is retryable policy, not failure.
+            payload = json.dumps({
+                "error": str(exc), "shed": True,
+                "priority": exc.priority,
+                "retry_after_s": exc.retry_after_s,
+            }).encode()
+            self.send_response(503)
+            self.send_header("Retry-After",
+                             str(max(1, math.ceil(exc.retry_after_s))))
         except Exception as exc:  # noqa: BLE001 — request error boundary
             payload = json.dumps({"error": repr(exc)}).encode()
             self.send_response(500)

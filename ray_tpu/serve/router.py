@@ -22,6 +22,8 @@ import random
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.exceptions import RequestSheddedError
+
 # A cached-prefix hit must cover at least this many tokens to override
 # the load-balancing choice (one block is the minimum shareable unit).
 PREFIX_MIN_OVERLAP_TOKENS = 1
@@ -29,6 +31,13 @@ PREFIX_MIN_OVERLAP_TOKENS = 1
 # least-loaded replica before locality yields to load (the
 # locality_load_slack idiom from the task router).
 PREFIX_LOAD_SLACK = 2
+
+# Priority-admission policy: class p may occupy up to
+# fraction[min(p, last)] of the deployment's max_ongoing_requests, so
+# as load builds the worst classes hit their (smaller) ceiling and shed
+# first while class 0 still admits up to the full cap — nested
+# thresholds, the standard priority-shedding shape.
+DEFAULT_CLASS_FRACTIONS = (1.0, 0.75, 0.5, 0.25)
 
 
 class ReplicaSet:
@@ -46,9 +55,16 @@ class ReplicaSet:
         self._prefix: Dict[int, Tuple[int, frozenset]] = {}
         self._lock = threading.Lock()
         self._rng = random.Random(0)
+        # Priority admission (None = unlimited, the default): total
+        # in-flight bound + per-class fractions of it.
+        self._max_ongoing: Optional[int] = None
+        self._class_fractions: Tuple[float, ...] = DEFAULT_CLASS_FRACTIONS
         # -- counters (tests/dashboards read these) --
         self.prefix_routed = 0          # requests routed by overlap
         self.prefix_overlap_tokens = 0  # cumulative overlap they carried
+        self.shed_total = 0             # requests refused by admission
+        self.shed_by_class: Dict[int, int] = {}
+        self.admitted_by_class: Dict[int, int] = {}
 
     def update(self, replicas: List[Any]):
         with self._lock:
@@ -64,6 +80,59 @@ class ReplicaSet:
     def size(self) -> int:
         with self._lock:
             return len(self._replicas)
+
+    # ------------------------------------------------- priority admission
+    def configure_admission(self, max_ongoing: Optional[int],
+                            class_fractions=None) -> None:
+        """Bound total in-flight requests across the deployment's
+        replicas. ``None`` disables admission control (default).
+        ``class_fractions[p]`` scales the bound per priority class
+        (class 0 = first entry = most important; classes past the end
+        use the last entry)."""
+        with self._lock:
+            self._max_ongoing = (None if max_ongoing is None
+                                 else max(1, int(max_ongoing)))
+            if class_fractions is not None:
+                self._class_fractions = tuple(
+                    float(f) for f in class_fractions) or \
+                    DEFAULT_CLASS_FRACTIONS
+
+    def _admit_locked(self, priority: int) -> None:
+        """Admission check for one request of class ``priority``; raises
+        a typed ``RequestSheddedError`` when the class's nested
+        threshold is full. Caller holds the lock and increments the
+        in-flight count right after (shed requests never count)."""
+        cap = self._max_ongoing
+        if cap is None:
+            self.admitted_by_class[priority] = \
+                self.admitted_by_class.get(priority, 0) + 1
+            return
+        p = max(0, int(priority))
+        frac = self._class_fractions[min(p, len(self._class_fractions) - 1)]
+        limit = max(1, int(cap * frac))
+        total = sum(self._inflight.values())
+        if total >= limit:
+            self.shed_total += 1
+            self.shed_by_class[p] = self.shed_by_class.get(p, 0) + 1
+            # Retry hint grows with how far past the class ceiling the
+            # deployment is running — a crude queueing-delay estimate.
+            retry = min(2.0, 0.1 * (1.0 + total / limit))
+            raise RequestSheddedError(
+                f"deployment at {total} ongoing requests >= class-{p} "
+                f"admission limit {limit} (cap {cap}); shed by policy",
+                priority=p, retry_after_s=retry)
+        self.admitted_by_class[p] = self.admitted_by_class.get(p, 0) + 1
+
+    def admission_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "max_ongoing_requests": self._max_ongoing,
+                "class_fractions": list(self._class_fractions),
+                "ongoing": sum(self._inflight.values()),
+                "shed_total": self.shed_total,
+                "shed_by_class": dict(self.shed_by_class),
+                "admitted_by_class": dict(self.admitted_by_class),
+            }
 
     # ---------------------------------------------------------- prefix tier
     def update_prefix_digest(self, key: int, block_size: int,
@@ -112,11 +181,14 @@ class ReplicaSet:
         return best
 
     # -------------------------------------------------------------- choose
-    def choose(self, prefix_tokens=None) -> (int, Any):
+    def choose(self, prefix_tokens=None, priority: int = 0) -> (int, Any):
         """Prefix-overlap scoring when ``prefix_tokens`` is given and a
         replica reported digests; otherwise power of two choices: sample
         two replicas, pick the one with the shorter queue. Falls back to
-        the single replica when size==1.
+        the single replica when size==1. When admission control is
+        configured (``max_ongoing_requests``) the request is first
+        admitted against its priority class's nested threshold — a shed
+        raises ``RequestSheddedError`` without touching any replica.
 
         Returns (key, replica); pass the key back to release()."""
         # Hash the prompt OUTSIDE the lock (a 4k prompt is hundreds of
@@ -136,6 +208,7 @@ class ReplicaSet:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas available")
+            self._admit_locked(priority)
             replica = None
             if digests_by_bs and n > 1 and self._prefix:
                 replica = self._prefix_candidate(digests_by_bs)

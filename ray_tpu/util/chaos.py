@@ -1,0 +1,61 @@
+"""Public chaos-engineering surface (reference role: upstream Ray's
+``release/nightly_tests/chaos_test`` NodeKiller utilities, promoted to
+a library so any workload can run under seeded faults).
+
+Quickstart::
+
+    from ray_tpu.util import chaos
+
+    # Wire faults for the whole process tree (or set RAY_TPU_CHAOS):
+    inj = chaos.install(chaos.ChaosConfig(seed=7, delay=0.2, delay_ms=5,
+                                          reset=0.01, sites=("peer",)))
+    ... drive the workload ...
+    print(inj.counters)          # {site: {fault: count}} — exact record
+    chaos.uninstall()
+
+    # Seeded process killer during a live workload:
+    with chaos.NodeKiller([chaos.worker_kill_target()], seed=7,
+                          interval_s=(0.2, 0.5), max_kills=3) as killer:
+        ... workload with retries/lineage ...
+    print(killer.kills)
+
+``chaos.snapshot()`` (also served at the dashboard's ``/api/chaos``)
+reports the active config, per-site injected-fault counters and every
+recorded kill; all-zero when chaos never ran.
+"""
+
+from ray_tpu._private.chaos import (  # noqa: F401
+    ChaosConfig,
+    ChaosController,
+    ChaosInjector,
+    KillTarget,
+    NodeKiller,
+    active,
+    current,
+    install,
+    install_from_env,
+    pid_kill_target,
+    popen_kill_target,
+    snapshot,
+    uninstall,
+    wire_counters,
+    worker_kill_target,
+)
+
+__all__ = [
+    "ChaosConfig",
+    "ChaosController",
+    "ChaosInjector",
+    "KillTarget",
+    "NodeKiller",
+    "active",
+    "current",
+    "install",
+    "install_from_env",
+    "pid_kill_target",
+    "popen_kill_target",
+    "snapshot",
+    "uninstall",
+    "wire_counters",
+    "worker_kill_target",
+]

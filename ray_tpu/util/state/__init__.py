@@ -204,6 +204,61 @@ def summarize_workflows(
     return counts
 
 
+def chaos_summary() -> Dict[str, Any]:
+    """Chaos + load-shedding panel (`/api/chaos` role): the active
+    wire-fault config and per-site injected-fault counters, every kill
+    recorded by NodeKillers in this process, and shed/admission stats
+    from both shedding tiers — serve deployments (priority admission in
+    the router) and LLM engines (waitqueue eviction). Always safe to
+    call; all-zero/empty when chaos never ran and nothing shed."""
+    from ray_tpu._private import chaos as _chaos
+
+    out: Dict[str, Any] = _chaos.snapshot()
+
+    # Serve-tier shedding: per-deployment admission stats off the live
+    # controller singleton (never constructs one just to report zeros).
+    serve_shedding: Dict[str, Any] = {}
+    try:
+        from ray_tpu.serve import controller as _controller
+
+        ctl = _controller._controller
+        if ctl is not None:
+            with ctl._lock:
+                infos = list(ctl._deployments.values())
+            for info in infos:
+                serve_shedding[info.name] = \
+                    info.replica_set.admission_stats()
+    except Exception:  # noqa: BLE001 — panel must not fail the API
+        pass
+    out["serve_shedding"] = serve_shedding
+    out["serve_shed_total"] = sum(
+        s.get("shed_total", 0) for s in serve_shedding.values())
+
+    # LLM-tier shedding: waitqueue evictions per engine. Only consulted
+    # when the llm layer is already loaded — the panel must not drag jax
+    # into processes that never served a model.
+    llm_shedding: Dict[int, Any] = {}
+    try:
+        import sys
+
+        live_engines = (
+            sys.modules["ray_tpu.llm.engine"].live_engines
+            if "ray_tpu.llm.engine" in sys.modules else lambda: [])
+        for eng in live_engines():
+            st = eng.stats()
+            llm_shedding[st["engine_id"]] = {
+                "shed_requests": st.get("shed_requests", 0),
+                "shed_by_class": st.get("shed_by_class", {}),
+                "submitted_by_class": st.get("submitted_by_class", {}),
+            }
+    except Exception:  # noqa: BLE001 — llm layer optional (needs jax)
+        pass
+    out["llm_shedding"] = llm_shedding
+    out["llm_shed_total"] = sum(
+        s.get("shed_requests", 0) for s in llm_shedding.values())
+    return out
+
+
 def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
     from ray_tpu.util.placement_group import placement_group_table
 

@@ -88,7 +88,8 @@ def _record_order(path: str) -> tuple:
 DEFAULT_REQUIRED = ("cluster_fanout_1k.tasks_per_sec,"
                     "streaming.backpressured_items_per_sec,"
                     "llm_serving.continuous_tokens_per_sec,"
-                    "llm_prefix.cached_tokens_per_sec")
+                    "llm_prefix.cached_tokens_per_sec,"
+                    "chaos_slo.p99_ttft_under_kill")
 
 
 def check_required(paths: list, curr: dict, threshold: float,

@@ -308,13 +308,15 @@ def test_check_bench_requires_cluster_metric(tmp_path):
            {"cluster_fanout_1k": {"tasks_per_sec": 250.0}})
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     # Every required metric present and holding -> gate passes (PR 5
-    # adds llm_serving.continuous_tokens_per_sec and PR 7 adds
-    # llm_prefix.cached_tokens_per_sec to the required set).
+    # adds llm_serving.continuous_tokens_per_sec, PR 7 adds
+    # llm_prefix.cached_tokens_per_sec, and PR 8 adds
+    # chaos_slo.p99_ttft_under_kill to the required set).
     _write("BENCH_pr03.json",
            {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
             "streaming": {"backpressured_items_per_sec": 150.0},
             "llm_serving": {"continuous_tokens_per_sec": 1000.0},
-            "llm_prefix": {"cached_tokens_per_sec": 400.0}})
+            "llm_prefix": {"cached_tokens_per_sec": 400.0},
+            "chaos_slo": {"p99_ttft_under_kill": 30.0}})
     assert check_bench.main(["--dir", str(tmp_path)]) == 0
     # A later record whose streaming throughput regressed vs the last
     # record carrying it -> gate fails.
@@ -322,7 +324,8 @@ def test_check_bench_requires_cluster_metric(tmp_path):
            {"cluster_fanout_1k": {"tasks_per_sec": 240.0},
             "streaming": {"backpressured_items_per_sec": 60.0},
             "llm_serving": {"continuous_tokens_per_sec": 1000.0},
-            "llm_prefix": {"cached_tokens_per_sec": 400.0}})
+            "llm_prefix": {"cached_tokens_per_sec": 400.0},
+            "chaos_slo": {"p99_ttft_under_kill": 30.0}})
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     assert key  # silence linters: key documents the gated metric
 

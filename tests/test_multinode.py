@@ -325,13 +325,15 @@ def test_peer_pull_falls_back_to_relay(two_node_cluster):
         return "via-relay"
 
     ref = make.remote()
-    # Poison the peer pool: any direct dial fails instantly, so the pull
-    # must take the relay path.
-    orig = w.head_client._peers.pull
-    w.head_client._peers.pull = lambda addr, oid: None
+    # Poison the peer pool: every direct attempt (including the bounded
+    # pull_retrying reconnect loop) fails as a transport error, so the
+    # pull must exhaust its attempts and take the relay path.
+    orig = w.head_client._peers._pull_attempt
+    w.head_client._peers._pull_attempt = \
+        lambda addr, oid: ("error", None)
     try:
         before = w.head_client.relayed_pulls
         assert ray_tpu.get(ref, timeout=60) == "via-relay"
         assert w.head_client.relayed_pulls > before
     finally:
-        w.head_client._peers.pull = orig
+        w.head_client._peers._pull_attempt = orig

@@ -1,0 +1,117 @@
+"""Object spill / eviction under memory pressure (VERDICT Weak #1:
+the spill path had no direct coverage). Three tiers:
+
+- store-level: passing the configured cap spills sealed objects to the
+  spill dir and ``get`` restores them transparently (bytes identical,
+  counters move);
+- worker-level: task-produced objects spill under a tiny cap and
+  ``ray_tpu.get`` pulls them back without the caller noticing;
+- failure composition: a LOST copy (spilled file destroyed, entry
+  marked lost) is rebuilt through lineage on the sim cluster — the
+  chaos matrix's "no fault may strand a ref" invariant for the memory
+  axis.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.serialization import SerializationContext
+
+
+@pytest.fixture
+def small_store_cap():
+    """Tiny in-process store cap so a few 100 KiB objects overflow it."""
+    GlobalConfig.set("object_store_memory_bytes", 256 * 1024)
+    yield
+    GlobalConfig.reset()
+
+
+def _serialized(ctx, value):
+    return ctx.serialize(value)
+
+
+def test_store_spills_past_cap_and_restores(tmp_path, small_store_cap):
+    store = ObjectStore(spill_dir=str(tmp_path / "spill"))
+    ctx = SerializationContext()
+    blobs = {ObjectID.from_random(): np.random.default_rng(i).bytes(
+        200 * 1024) for i in range(4)}
+    for oid, blob in blobs.items():
+        store.put(oid, _serialized(ctx, blob))
+    st = store.stats()
+    assert st["spilled_bytes"] > 0, "cap pressure did not spill"
+    spilled = [oid for oid, _, _, _, _, sp in store.entries_snapshot()
+               if sp]
+    assert spilled, "no entry reports a spilled copy"
+    # Spilled files exist on disk and memory accounting dropped.
+    assert any(os.scandir(str(tmp_path / "spill")))
+    assert st["memory_used_bytes"] <= 256 * 1024 + 200 * 1024
+    # Transparent restore: get() returns identical bytes for EVERY
+    # object, spilled or resident, and the restore counter moves.
+    for oid, blob in blobs.items():
+        assert ctx.deserialize(store.get(oid, timeout=5)) == blob
+    assert store.stats()["restored_bytes"] > 0
+
+
+def test_worker_get_pulls_spilled_objects_back(small_store_cap):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        w = ray_tpu._private.worker.global_worker()
+
+        @ray_tpu.remote
+        def blob(i):
+            return np.full(64 * 1024, i, dtype=np.uint8)
+
+        refs = [blob.remote(i) for i in range(8)]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=30)
+        assert w.store.stats()["spilled_bytes"] > 0, \
+            "8x64KiB results under a 256KiB cap must spill"
+        # Every value comes back bit-correct, spilled or not.
+        for i, ref in enumerate(refs):
+            out = ray_tpu.get(ref)
+            assert out.shape == (64 * 1024,) and int(out[0]) == i
+        assert w.store.stats()["restored_bytes"] > 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_lineage_rebuilds_lost_spilled_copy(small_store_cap):
+    """Spill + loss composed: destroy a spilled object's file AND mark
+    the entry lost — lineage re-executes the producer on get()."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        w = ray_tpu._private.worker.global_worker()
+
+        @ray_tpu.remote
+        def blob(i):
+            return np.full(96 * 1024, i, dtype=np.uint8)
+
+        refs = [blob.remote(i) for i in range(6)]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=30)
+        snapshot = {oid: sp for oid, _, _, _, _, sp
+                    in w.store.entries_snapshot()}
+        victims = [r for r in refs if snapshot.get(r.object_id)]
+        assert victims, "no spilled result to lose"
+        victim = victims[0]
+        # Lose the spilled copy: unlink the file, poison the entry.
+        entry = w.store._entries[victim.object_id]
+        os.unlink(entry.spilled_path)
+        w.store.mark_lost(victim.object_id)
+        out = ray_tpu.get(victim, timeout=30)
+        i = refs.index(victim)
+        assert out.shape == (96 * 1024,) and int(out[0]) == i, \
+            "lineage did not rebuild the lost spilled copy"
+    finally:
+        cluster.shutdown()
+        ray_tpu.shutdown()
