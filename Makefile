@@ -1,10 +1,19 @@
 PYTHON ?= python
 
-.PHONY: test bench bench-control-plane bench-llm bench-llm-prefix \
-	bench-gate bench-chaos chaos-gate
+.PHONY: test analyze bench bench-control-plane bench-llm \
+	bench-llm-prefix bench-gate bench-chaos chaos-gate
 
-test:
+test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# Project-invariant static analysis (lock discipline, counter balance,
+# exception discipline, RAY_TPU_* flag hygiene, thread hygiene) gated
+# against scripts/raylint_baseline.json — fails on any NEW finding, on
+# stale baseline entries, and on the baseline budget being exceeded
+# (the baseline only ever shrinks). Also enforced inside tier-1 via
+# tests/test_raylint.py.
+analyze:
+	$(PYTHON) scripts/raylint.py ray_tpu/
 
 bench:
 	$(PYTHON) bench.py --all

@@ -21,9 +21,10 @@ def _src_dir() -> str:
 
 
 def _cache_dir() -> str:
-    d = os.environ.get(
-        "RAY_TPU_NATIVE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu"))
+    from ray_tpu._private.config import GlobalConfig
+
+    d = GlobalConfig.native_cache or os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu")
     os.makedirs(d, exist_ok=True)
     return d
 

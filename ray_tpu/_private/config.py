@@ -196,3 +196,26 @@ _D("worker_channel_bytes", int, 1024 * 1024,
    "Request/reply channel buffer size per worker process (4 channels per "
    "worker are resident in the shm store; larger blobs are staged as "
    "regular shm objects instead of widening the channels).")
+_D("log_level", str, "warning",
+   "Threshold for the ray_tpu logger hierarchy (debug/info/warning/"
+   "error). Daemon loops log swallowed transient failures at debug; "
+   "survivable-but-unexpected conditions at warning.")
+_D("head_client_timeout_s", float, 5.0,
+   "Per-request timeout for short head-service RPCs issued by "
+   "tooling/state clients (the CLI, dashboards); the long-lived "
+   "HeadClient channels use their own reconnect-and-resume protocol.")
+_D("workflow_storage", str, "",
+   "Default workflow storage root URI ('' = ~/.ray_tpu/workflows; "
+   "supports local paths, memory://, and fsspec URIs).")
+_D("runtime_env_cache", str, "",
+   "Directory for built runtime-env (pip) environments "
+   "('' = ~/.cache/ray_tpu/runtime_envs).")
+_D("native_cache", str, "",
+   "Directory for compiled native-layer artifacts "
+   "('' = ~/.cache/ray_tpu).")
+_D("coordinator_address", str, "",
+   "Multi-process device-plane coordinator address for "
+   "parallel.distributed.initialize ('' = single-process mesh).")
+_D("head_log_compact_records", int, 50000,
+   "Compact the head's append-only state log once it holds this many "
+   "records (snapshot + truncate; 0 disables compaction).")

@@ -21,6 +21,10 @@ import pickle
 import threading
 from typing import Any, List, Optional
 
+from ray_tpu._private.log import get_logger
+
+log = get_logger(__name__)
+
 # Replies bigger than this ride the shm store (service_loop enforces it
 # uniformly for every reply kind; headroom under the 1MB channels).
 
@@ -168,7 +172,9 @@ def service_loop(proc) -> None:
             if not proc.alive():
                 break
             continue
-        except (ChannelError, Exception):  # noqa: BLE001 — torn down
+        except (ChannelError, Exception) as exc:  # noqa: BLE001
+            log.debug("driver api channel torn down; service loop "
+                      "exiting: %r", exc)
             break
         worker = worker_mod._try_global_worker()
         try:
@@ -191,11 +197,13 @@ def service_loop(proc) -> None:
                 key = _next_reply_key()
                 proc._store.put(key, raw)
                 reply = ("okshm_reply", key)
-        except Exception:  # noqa: BLE001 — unpicklable reply stays inline
-            pass
+        except Exception as exc:  # unpicklable reply stays inline
+            log.debug("reply staging failed; sending inline: %r", exc)
         try:
             proc._api_rep.write(reply, timeout=10.0)
-        except Exception:  # noqa: BLE001 — worker died mid-reply
+        except Exception as exc:  # worker died mid-reply
+            log.debug("api reply write failed (worker %s): %r",
+                      "dead" if not proc.alive() else "alive", exc)
             if not proc.alive():
                 break
     state.clear()

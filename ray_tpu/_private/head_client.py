@@ -34,6 +34,7 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.transport import (
     FramedConnection,
     connect,
@@ -41,6 +42,8 @@ from ray_tpu._private.transport import (
     resolve_token,
     wire_to_exc,
 )
+
+log = get_logger(__name__)
 
 _PULL_CHUNK = 4 * 1024 * 1024  # object pulls ride 4 MiB frames
 _PULL_WINDOW = 16   # outstanding relayed chunk requests per pull
@@ -130,10 +133,17 @@ class HeadClient:
         # driver's remote router consumes task completions.
         self.handlers: Dict[str, Callable[[tuple], Any]] = {}
         self.status_fn: Optional[Callable[[], dict]] = None
-        self._hb_lock = threading.Lock()
-        self._subs_lock = threading.Lock()
+        # Tracked locks feed the sanitizer's lock-order watcher under
+        # RAY_TPU_SANITIZE=1 (plain-Lock cost otherwise): this class
+        # holds the most locks in the tree, so an accidental nesting
+        # inversion here is the likeliest host-plane deadlock.
+        from ray_tpu.util import sanitizer
+
+        self._hb_lock = sanitizer.tracked_lock("head_client.hb")
+        self._subs_lock = sanitizer.tracked_lock("head_client.subs")
         self._subs: Dict[str, list] = {}  # topic -> delivery callbacks
-        self._reconnect_lock = threading.Lock()
+        self._reconnect_lock = sanitizer.tracked_lock(
+            "head_client.reconnect")
         self._stop = threading.Event()
         self._req = self._dial("request")
         self._hb = self._dial("request")
@@ -164,7 +174,8 @@ class HeadClient:
         self._serialized_cache: "_OD[bytes, bytes]" = _OD()
         self._serialized_cache_bytes = 0
         self._serialized_cache_cap = 256 << 20
-        self._serialized_cache_lock = threading.Lock()
+        self._serialized_cache_lock = sanitizer.tracked_lock(
+            "head_client.serialized_cache")
         # Relayed-call results pinned until pulled (bounded FIFO).
         # Guarded by its own lock: relayed actor_call events each run on
         # a dedicated thread (plus the pool), and unlocked concurrent
@@ -172,7 +183,8 @@ class HeadClient:
         from collections import OrderedDict
 
         self._pinned_results: "OrderedDict[bytes, Any]" = OrderedDict()
-        self._pinned_results_lock = threading.Lock()
+        self._pinned_results_lock = sanitizer.tracked_lock(
+            "head_client.pinned_results")
         # Direct data plane (ObjectManager role): serve local objects to
         # peers; pull remote objects peer-to-peer when the head knows the
         # owner's address, falling back to head-relayed chunks.
@@ -593,7 +605,9 @@ class HeadClient:
             try:
                 self._event = self._dial("event")
                 return True
-            except Exception:  # noqa: BLE001 — head not back yet
+            except Exception as exc:  # head not back yet
+                log.debug("event channel re-dial failed; retrying: %r",
+                          exc)
                 _time.sleep(0.5)
         return False
 
@@ -756,12 +770,20 @@ class HeadClient:
                 pass
 
     def _heartbeat_loop(self):
+        # _hb_lock guards only the self._hb REFERENCE (swap on re-dial,
+        # close on shutdown); the send/recv round trip and the re-dial
+        # run on a local ref outside it. Holding the lock across the
+        # wire (as this loop once did) meant close() — and anything
+        # else serialized on the lock — stalled behind a heartbeat
+        # round trip or a multi-address 5s-per-standby re-dial.
         while not self._stop.wait(0.5):
             status = None
             if self.status_fn is not None:
                 try:
                     status = self.status_fn()
-                except Exception:  # noqa: BLE001
+                except Exception as exc:  # status is best-effort
+                    log.debug("status_fn failed; sending bare "
+                              "heartbeat: %r", exc)
                     status = None
             with self._subs_lock:
                 topics = list(self._subs)
@@ -770,20 +792,36 @@ class HeadClient:
                 status["_subs"] = topics
             status["_peer_addr"] = list(self._object_server.address)
             msg = ("heartbeat", status)
+            with self._hb_lock:
+                hb = self._hb
             try:
+                hb.send(msg)
+                self._check(hb.recv())
+            except Exception as exc:  # re-dial until the head returns
+                log.debug("heartbeat failed; re-dialing head: %r", exc)
+                try:
+                    hb.close()
+                except Exception as exc2:
+                    log.debug("closing dead heartbeat conn: %r", exc2)
+                try:
+                    fresh = self._dial("request")
+                except Exception as exc2:  # still down — next tick retries
+                    log.debug("head still down: %r", exc2)
+                    continue
+                stale = None
                 with self._hb_lock:
-                    self._hb.send(msg)
-                    self._check(self._hb.recv())
-            except Exception:  # noqa: BLE001 — re-dial until head returns
-                with self._hb_lock:
+                    if self._stop.is_set():
+                        # close() already swept self._hb — a conn
+                        # published now would leak its socket for good
+                        stale = fresh
+                    else:
+                        self._hb = fresh
+                if stale is not None:
                     try:
-                        self._hb.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                    try:
-                        self._hb = self._dial("request")
-                    except Exception:  # noqa: BLE001 — still down
-                        pass
+                        stale.close()
+                    except Exception as exc2:
+                        log.debug("closing post-shutdown re-dial: %r",
+                                  exc2)
 
     def close(self):
         self._stop.set()
@@ -805,7 +843,13 @@ class HeadClient:
             self._peers.close()
         except Exception:  # noqa: BLE001
             pass
-        for conn in (self._req, self._event, self._hb):
+        # Sweep the heartbeat conn under its lock: the heartbeat loop
+        # checks _stop before publishing a re-dialed conn, so after this
+        # point no fresh conn can appear (a racing re-dial closes its
+        # own result when it sees _stop set).
+        with self._hb_lock:
+            hb = self._hb
+        for conn in (self._req, self._event, hb):
             try:
                 conn.close()
             except Exception:  # noqa: BLE001

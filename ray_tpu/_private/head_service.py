@@ -41,6 +41,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.transport import (
     FramedConnection,
     TokenListener,
@@ -51,6 +52,8 @@ from ray_tpu._private.transport import (
     unpack,
     write_token,
 )
+
+log = get_logger(__name__)
 
 try:
     import fcntl
@@ -76,7 +79,9 @@ def _reply_bytes_estimate(replies: list) -> int:
 
 
 def _client_timeout_s() -> float:
-    return float(os.environ.get("RAY_TPU_HEAD_CLIENT_TIMEOUT_S", "5.0"))
+    from ray_tpu._private.config import GlobalConfig
+
+    return float(GlobalConfig.head_client_timeout_s)
 
 
 class _EventChannel:
@@ -106,8 +111,8 @@ class _EventChannel:
                 if slot is not None:
                     slot[1], slot[2] = status, value
                     slot[0].set()
-        except Exception:  # noqa: BLE001 — channel gone
-            self.fail_all("event channel closed")
+        except Exception as exc:  # channel gone
+            self.fail_all(f"event channel closed: {exc!r}")
 
     def fail_all(self, why: str):
         self.alive = False
@@ -280,7 +285,9 @@ class _StateLog:
                     return  # torn tail
                 try:
                     yield unpack(data)
-                except Exception:  # noqa: BLE001 — corrupt record ends log
+                except Exception as exc:  # corrupt record ends log
+                    log.warning("corrupt state-log record ends replay "
+                                "early: %r", exc)
                     return
 
     def close(self):
@@ -320,8 +327,10 @@ class HeadService:
         # {"node": hosting client, "driver": owning client, "cls": bytes,
         #  "class_name": str, "detached": bool}.
         self._places: Dict[bytes, dict] = {}
-        self._compact_threshold = int(os.environ.get(
-            "RAY_TPU_HEAD_LOG_COMPACT_RECORDS", "50000"))
+        from ray_tpu._private.config import GlobalConfig
+
+        self._compact_threshold = int(
+            GlobalConfig.head_log_compact_records)
         self._compact_pending = False
         self._log: Optional[_StateLog] = None
         if state_path:
@@ -798,8 +807,9 @@ class HeadService:
                 self._compact_pending = False
                 try:
                     self._compact()
-                except Exception:  # noqa: BLE001 — disk trouble: keep log
-                    pass
+                except Exception as exc:  # disk trouble: keep the log
+                    log.warning("state-log compaction failed; appending "
+                                "to the uncompacted log: %r", exc)
             now = time.monotonic()
             newly_dead = []
             with self._lock:
@@ -889,7 +899,9 @@ def run_standby(primary: str, token: str, probe_period_s: float = 1.0,
                     "standby token does not match the primary's cluster "
                     "token — refusing to promote") from exc
             misses += 1
-        except Exception:  # noqa: BLE001 — primary unreachable
+        except Exception as exc:  # primary unreachable
+            log.debug("standby probe missed the primary (%d): %r",
+                      misses + 1, exc)
             misses += 1
 
 

@@ -1,0 +1,65 @@
+"""Minimal structured logging for the host control plane.
+
+The daemon/server loops deliberately survive transient failures (head
+restarts, dying peers, racing shutdowns) — but *silently* surviving
+them is how a dead reporter thread goes unnoticed for a week. raylint's
+exception-discipline pass forbids swallowing an exception in a loop
+without logging it; this module is the sanctioned sink.
+
+Usage::
+
+    from ray_tpu._private.log import get_logger
+    log = get_logger(__name__)
+    ...
+    except Exception as exc:  # transient: head not back yet
+        log.debug("heartbeat failed; re-dialing: %r", exc)
+
+Levels follow intent: ``debug`` for expected/transient conditions a
+retry loop absorbs (off by default — zero noise in production),
+``warning`` for conditions that should not happen but are survivable,
+``error`` for giving up. The root ``ray_tpu`` logger gets one stderr
+handler configured lazily; ``RAY_TPU_LOG_LEVEL`` (via
+``_private/config.py``) sets the threshold, default ``warning``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+
+_configure_lock = threading.Lock()
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    with _configure_lock:
+        if _configured:
+            return
+        _configured = True
+        root = logging.getLogger("ray_tpu")
+        if root.handlers:
+            return  # the embedding app configured logging itself
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(
+            "[ray_tpu %(levelname).1s %(name)s] %(message)s"))
+        root.addHandler(handler)
+        root.propagate = False
+        try:
+            from ray_tpu._private.config import GlobalConfig
+            level = str(GlobalConfig.log_level).upper()
+        except Exception as exc:  # config unimportable mid-bootstrap
+            print(f"[ray_tpu] log config unavailable ({exc!r}); "
+                  f"defaulting to WARNING", file=sys.stderr)
+            level = "WARNING"
+        root.setLevel(getattr(logging, level, logging.WARNING))
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Logger under the ``ray_tpu`` hierarchy; accepts ``__name__`` or
+    a bare suffix."""
+    _configure()
+    if not name.startswith("ray_tpu"):
+        name = f"ray_tpu.{name}"
+    return logging.getLogger(name)

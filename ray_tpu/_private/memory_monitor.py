@@ -17,6 +17,10 @@ from __future__ import annotations
 import os
 import threading
 import time
+
+from ray_tpu._private.log import get_logger
+
+log = get_logger(__name__)
 from typing import Optional
 
 
@@ -73,8 +77,9 @@ class MemoryMonitor:
             try:
                 if system_memory_usage_fraction() >= self.threshold:
                     self._kill_one()
-            except Exception:  # noqa: BLE001 — monitor must not die
-                pass
+            except Exception as exc:  # monitor must not die
+                log.warning("memory-monitor sweep failed; retrying next "
+                            "period: %r", exc)
 
     def _pick_victim(self):
         """Youngest running process task whose worker is actually using

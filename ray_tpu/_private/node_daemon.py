@@ -46,7 +46,10 @@ from typing import Any, Callable, Dict
 
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.scheduler import TaskSpec
+
+log = get_logger(__name__)
 
 
 def completion_fields(store, return_ids: list, name: str):
@@ -427,7 +430,8 @@ class NodeDaemon:
             except RayTaskError as exc:
                 store.put_error(oid, exc)
                 return
-            except Exception:  # noqa: BLE001 — head hiccup: retry below
+            except Exception as exc:  # head hiccup: retry below
+                log.debug("object pull failed; retrying: %r", exc)
                 raw = None
             if raw is not None:
                 store.put(oid, SerializedObject.from_bytes(raw))
@@ -654,13 +658,16 @@ class NodeDaemon:
                                 while len(self._stream_done_order) > 65536:
                                     self._stream_done.discard(
                                         self._stream_done_order.popleft())
-                except Exception:  # noqa: BLE001 — keep reporting others
-                    pass
+                except Exception as exc:  # keep reporting others
+                    log.warning("dropping one malformed completion "
+                                "record; reporting the rest: %r", exc)
             announced = True
             try:
                 self.head.object_announce_many(announce)
-            except Exception:  # noqa: BLE001 — head hiccup: take the
-                announced = False  # relay, which re-records locations
+            except Exception as exc:  # head hiccup: take the relay,
+                announced = False     # which re-records locations
+                log.debug("announce batch failed; falling back to "
+                          "relayed completions: %r", exc)
             by_driver: Dict[tuple, list] = {}
             for rec in built:
                 by_driver.setdefault((rec[2], rec[3]), []).append(rec)
@@ -689,8 +696,9 @@ class NodeDaemon:
                             # Per-item relay fallback rides pub/sub.
                             self.head.publish(f"stream|{driver_id}",
                                               ("item_done", rec[1]))
-                except Exception:  # noqa: BLE001 — driver gone:
-                    pass           # results stay local
+                except Exception as exc:  # driver gone: results stay
+                    log.debug("completion relay to driver %s failed "
+                              "(results stay local): %r", driver_id, exc)
 
     # -------------------------------------------------------------- lifecycle
     def run_forever(self):

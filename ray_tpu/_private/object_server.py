@@ -16,6 +16,10 @@ from __future__ import annotations
 
 import socket
 import threading
+
+from ray_tpu._private.log import get_logger
+
+log = get_logger(__name__)
 from typing import Callable, Dict, Optional, Tuple
 
 from ray_tpu._private.transport import (
@@ -84,7 +88,9 @@ class ObjectServer:
                     try:
                         raw = self._provider(bytes(msg[1]))
                         conn.send(("ok", len(raw)))
-                    except Exception:  # noqa: BLE001 — not owned here
+                    except Exception as exc:  # not owned here
+                        log.debug("meta miss (object not owned here): "
+                                  "%r", exc)
                         conn.send(("ok", None))
                 elif kind == "chunk":
                     _, oid, offset, length = msg
@@ -94,7 +100,9 @@ class ObjectServer:
                         # without an intermediate bytes copy.
                         conn.send(("ok",
                                    memoryview(raw)[offset:offset + length]))
-                    except Exception:  # noqa: BLE001
+                    except Exception as exc:  # not owned / raced free
+                        log.debug("chunk miss (object not owned here): "
+                                  "%r", exc)
                         conn.send(("ok", None))
                 elif kind in self.handlers:
                     try:

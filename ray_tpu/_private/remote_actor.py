@@ -34,9 +34,12 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.object_server import PeerUnreachableError
 from ray_tpu._private.serialization import SerializedObject
 from ray_tpu.exceptions import ActorDiedError, RayTaskError
+
+log = get_logger(__name__)
 
 _STOP = object()
 
@@ -529,8 +532,9 @@ class ActorHost:
                 return
             try:
                 self._dispatch_submit(p)
-            except Exception:  # noqa: BLE001 — errors already materialized
-                pass
+            except Exception as exc:  # errors already materialized
+                log.debug("actor submit dispatch failed (error already "
+                          "materialized to its refs): %r", exc)
 
     def _dispatch_submit(self, p: dict):
         aid = ActorID(bytes(p["actor_id"]))

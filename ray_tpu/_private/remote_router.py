@@ -63,8 +63,11 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.object_server import PeerUnreachableError
 from ray_tpu._private.scheduler import TaskSpec, _collect_refs
+
+log = get_logger(__name__)
 from ray_tpu.exceptions import (
     GetTimeoutError,
     RayTaskError,
@@ -1270,7 +1273,8 @@ class RemoteRouter:
                 # payload, e.g. across a head restart).
                 self.worker.store.put_error(object_id, task_exc)
                 return
-            except Exception:  # noqa: BLE001 — head hiccup: retry loop
+            except Exception as exc:  # head hiccup: retry loop
+                log.debug("ensure_local pull failed; retrying: %r", exc)
                 raw = None
             if raw is not None:
                 self.worker.store.put(
@@ -1385,8 +1389,9 @@ class RemoteRouter:
             for rt in actors:
                 try:
                     rt.check_node(alive)
-                except Exception:  # noqa: BLE001 — keep the watcher alive
-                    pass
+                except Exception as exc:  # keep the watcher alive
+                    log.warning("remote-actor liveness check failed; "
+                                "watcher continues: %r", exc)
             with self._lock:
                 self.remote_actors = [rt for rt in self.remote_actors
                                       if not rt.dead]

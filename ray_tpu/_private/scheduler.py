@@ -21,7 +21,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.task_events import TaskEventBuffer
+
+log = get_logger(__name__)
 from ray_tpu.exceptions import (
     RayTaskError,
     RuntimeEnvSetupError,
@@ -251,6 +254,7 @@ class LocalScheduler:
                 self._backlog += 1
                 self._dq_handles[spec.task_id] = handle
                 self._dq_specs[handle] = spec
+                registered = False
                 try:
                     for ref in dep_refs:
                         producer = self._dq_handles.get(
@@ -261,21 +265,27 @@ class LocalScheduler:
                             dq.add_dep(handle, producer)
                         else:
                             fallback_refs.append(ref)
-                except MemoryError:
-                    # Edge table full mid-registration: unwind everything
-                    # this call registered so the caller's python-path
-                    # fallback starts from a clean slate (no double-counted
-                    # backlog, no stale never-completed handle for
-                    # consumers to dep on).
-                    del self._dq_handles[spec.task_id]
-                    del self._dq_specs[handle]
-                    self._backlog -= 1
-                    raise
-                if not fallback_refs:
-                    dq.commit(handle)
-                    return
-                self._pending_deps[spec.task_id] = len(fallback_refs)
-        except MemoryError:
+                    if not fallback_refs:
+                        dq.commit(handle)
+                        registered = True
+                        return
+                    self._pending_deps[spec.task_id] = len(fallback_refs)
+                    registered = True
+                finally:
+                    if not registered:
+                        # ANY failure mid-registration (edge table full,
+                        # a raising store/commit, bad ids) unwinds
+                        # everything this call registered so the
+                        # caller's python-path fallback starts from a
+                        # clean slate (no double-counted backlog, no
+                        # stale never-completed handle for consumers to
+                        # dep on). MemoryError-only unwind used to leak
+                        # _backlog — and the handle — on every other
+                        # exception class.
+                        del self._dq_handles[spec.task_id]
+                        del self._dq_specs[handle]
+                        self._backlog -= 1
+        except Exception:
             dq.abort(handle)  # recycle the slot; edges into it go stale
             raise
 
@@ -595,8 +605,9 @@ class LocalScheduler:
                     self._deferred_deletes.discard(key)
                     try:
                         self._shm_store.delete(key)
-                    except Exception:  # noqa: BLE001 — not present
-                        pass
+                    except Exception as exc:  # not present
+                        log.debug("ret-key %s already gone: %r", key,
+                                  exc)
             remaining = still
             if not remaining:
                 return
@@ -1047,7 +1058,9 @@ def pump_stream_replies(w, task_id, name: str, stream, store, shm_store,
                     return
                 _send_ack(-1)
                 continue
-            except Exception:  # noqa: BLE001 — channel torn down
+            except Exception as exc:  # channel torn down
+                log.debug("drain-after-error read failed; condemning "
+                          "worker: %r", exc)
                 break
             if m and m[0] in ("ok", "cancelled", "err"):
                 return
@@ -1081,8 +1094,9 @@ def pump_stream_replies(w, task_id, name: str, stream, store, shm_store,
                         raw = bytes(shm_store.get(field[1]))
                         try:
                             shm_store.delete(field[1])
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as exc:  # staged key raced away
+                            log.debug("staged stream item %s already "
+                                      "deleted: %r", field[1], exc)
                     else:
                         raw = bytes(field)
                     store.put(stream_item_id(task_id, idx),

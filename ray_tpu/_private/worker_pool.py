@@ -22,7 +22,10 @@ import threading
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.worker_main import _ShmRef
+
+log = get_logger(__name__)
 from ray_tpu.exceptions import (
     ChannelError,
     ChannelTimeoutError,
@@ -40,8 +43,8 @@ def _pump_stream(stream, path: str):
         with open(path, "ab", buffering=0) as f:
             for chunk in iter(lambda: stream.readline(), b""):
                 f.write(chunk)
-    except Exception:  # noqa: BLE001 — worker died mid-write
-        pass
+    except Exception as exc:  # worker died mid-write
+        log.debug("worker log pump for %s stopped: %r", path, exc)
 
 
 def _try_owner_log_dir():

@@ -20,13 +20,17 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.worker import ObjectRef, auto_init, global_worker
+
 from ray_tpu.exceptions import (
     ActorDiedError,
     RayActorError,
     RayTaskError,
     TaskCancelledError,
 )
+
+log = get_logger(__name__)
 
 _TERMINATE = object()
 
@@ -274,8 +278,9 @@ class _ActorRuntime:
             if isinstance(call, _ClosureCall):
                 try:
                     call.fn(self.instance)
-                except Exception:  # noqa: BLE001 — exec loop boundary
-                    pass
+                except Exception as exc:  # exec loop boundary
+                    log.warning("actor closure call failed; exec loop "
+                                "continues: %r", exc)
                 continue
             if self._restart_pending and not self.dead:
                 try:
@@ -328,8 +333,9 @@ class _ActorRuntime:
             if isinstance(call, _ClosureCall):
                 try:
                     call.fn(self.instance)
-                except Exception:  # noqa: BLE001 — exec loop boundary
-                    pass
+                except Exception as exc:  # exec loop boundary
+                    log.warning("actor closure call failed; exec loop "
+                                "continues: %r", exc)
                 continue
             if (self._restart_pending or not self._proc.alive()) \
                     and not self.dead:
@@ -411,8 +417,8 @@ class _ActorRuntime:
             for key in ret_keys:
                 try:
                     shm.delete(key)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # slot already free
+                    log.debug("stale ret-key %s delete: %r", key, exc)
             entry = {"call": call, "staged": staged, "ret_keys": ret_keys}
             stream_budget = None
             if call.streaming:
@@ -453,8 +459,9 @@ class _ActorRuntime:
             for key in staged:
                 try:
                     shm.delete(key)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as del_exc:  # slot already free
+                    log.debug("staged-arg key %s delete: %r", key,
+                              del_exc)
             if isinstance(exc, RayTaskError):
                 self._fail_call(worker, call, exc)
             else:
@@ -490,7 +497,9 @@ class _ActorRuntime:
                 self._mux_propagate_cancels(proc)
                 self._mux_resend_watermarks(proc)
                 continue
-            except (ChannelError, Exception):  # noqa: BLE001 — torn down
+            except (ChannelError, Exception) as exc:  # noqa: BLE001
+                log.debug("mux reply channel torn down; pump exiting: "
+                          "%r", exc)
                 break
             if not msg or msg[0] != "calldone":
                 continue
@@ -510,16 +519,19 @@ class _ActorRuntime:
                         raw = bytes(shm.get(field[1]))
                         try:
                             shm.delete(field[1])
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as del_exc:  # raced away
+                            log.debug("staged item %s delete: %r",
+                                      field[1], del_exc)
                     else:
                         raw = bytes(field)
                     tid = entry["call"].return_ids[0].task_id()
                     worker.store.put(stream_item_id(tid, int(idx)),
                                      SerializedObject.from_bytes(raw))
                     stream.commit(int(idx))
-                except Exception:  # noqa: BLE001 — item frame corrupt:
-                    pass           # the terminal frame settles the call
+                except Exception as exc:  # item frame corrupt: the
+                    # terminal frame settles the call
+                    log.warning("dropping corrupt stream item frame: "
+                                "%r", exc)
                 self._mux_propagate_cancels(proc)
                 continue
             with self._mux_lock:
@@ -570,8 +582,9 @@ class _ActorRuntime:
                 for key in entry["staged"]:
                     try:
                         shm.delete(key)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as del_exc:  # slot already free
+                        log.debug("settled-call staged key %s delete: "
+                                  "%r", key, del_exc)
         # Worker died (or was replaced): fail everything still in flight
         # against THIS process.
         if proc is not self._proc:
@@ -590,8 +603,9 @@ class _ActorRuntime:
             for key in entry["staged"]:
                 try:
                     shm.delete(key)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as del_exc:  # slot already free
+                    log.debug("dead-actor staged key %s delete: %r",
+                              key, del_exc)
 
     def _mux_propagate_cancels(self, proc):
         """A consumer dropped its generator mid-stream: signal the worker

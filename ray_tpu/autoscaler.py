@@ -18,7 +18,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private.log import get_logger
 from ray_tpu.cluster_utils import Cluster, SimNode
+
+log = get_logger(__name__)
 
 
 @dataclass
@@ -196,8 +199,9 @@ class AutoscalingCluster(Cluster):
         while not self._stop.wait(self._interval):
             try:
                 self._update()
-            except Exception:  # noqa: BLE001 — monitor must not die
-                pass
+            except Exception as exc:  # monitor must not die
+                log.warning("autoscaler update failed; retrying next "
+                            "period: %r", exc)
 
     def _update(self):
         # 1. Scale up for unmet demand.
@@ -470,8 +474,9 @@ class ClusterAutoscaler:
         while not self._stop.wait(self._interval):
             try:
                 self._update()
-            except Exception:  # noqa: BLE001 — monitor must not die
-                pass
+            except Exception as exc:  # monitor must not die
+                log.warning("autoscaler update failed; retrying next "
+                            "period: %r", exc)
 
     def _update(self):
         shapes, nodes, backlog_pressure = self._observe()

@@ -1,0 +1,7 @@
+"""Developer tooling that ships with the tree but is not part of the
+runtime: static analysis (raylint), future codegen/benchmark helpers.
+
+Nothing under here may be imported by ``ray_tpu`` runtime modules — the
+tools import the runtime's *source* (as text/AST), never the other way
+around, so a broken checker can never take the control plane down.
+"""

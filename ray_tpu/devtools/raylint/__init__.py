@@ -1,0 +1,40 @@
+"""raylint — project-invariant static analysis for the ray_tpu tree.
+
+The reference C++ runtime leans on TSan/ASan builds to catch race and
+lifetime bugs (SURVEY §5.2); the Python host plane got the *runtime*
+half of that in ``ray_tpu/util/sanitizer.py``. raylint is the *static*
+half: an AST-based framework whose passes encode the invariants PRs
+hand-enforce in review:
+
+- **lock-discipline** — no blocking call (socket send/recv, sleep,
+  subprocess, transport pull/send_many, future .result()) inside a
+  ``with <lock>:`` body; plus a static lock-order graph with cycle
+  detection.
+- **counter-balance** — an increment of a tracked counter (one the
+  same scope also decrements) must have its paired decrement reachable
+  on exception exits (``finally``), or it leaks a slot on the first
+  raise.
+- **exception-discipline** — daemon/server loops must not swallow
+  exceptions via bare/broad ``except`` that neither logs, re-raises,
+  nor uses the caught exception.
+- **flag-hygiene** — every ``RAY_TPU_*`` flag is read through
+  ``_private/config.py`` (bootstrap identity flags excepted by
+  explicit allowlist), declared once, and documented in README.
+- **thread-hygiene** — every non-daemon ``threading.Thread`` is joined
+  on some shutdown path.
+
+Findings carry stable line-independent ids
+(``check:path:scope:detail``) so the committed baseline
+(``scripts/raylint_baseline.json``) survives unrelated edits; the
+baseline is gated to never grow. Suppress a single site with a
+``# raylint: disable=<check>`` comment on (or directly above) the
+flagged line.
+"""
+
+from ray_tpu.devtools.raylint.core import (  # noqa: F401
+    CHECKERS,
+    Checker,
+    Finding,
+    register,
+)
+from ray_tpu.devtools.raylint.runner import run_analysis  # noqa: F401

@@ -1,0 +1,10 @@
+"""Project-invariant passes. Importing this package registers all of
+them with the checker registry."""
+
+from ray_tpu.devtools.raylint.checks import (  # noqa: F401
+    counter_balance,
+    exception_discipline,
+    flag_hygiene,
+    lock_discipline,
+    thread_hygiene,
+)

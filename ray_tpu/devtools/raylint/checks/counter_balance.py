@@ -1,0 +1,205 @@
+"""counter-balance: tracked counter increments must have their paired
+decrement reachable on exception exits.
+
+A *tracked counter* is an attribute (or module global) that the same
+class (module) both increments and decrements somewhere — the
+accounting signature of slots, refcounts, in-flight windows and
+backpressure budgets. Monotonic stats counters (only ever ``+= 1``)
+are never tracked.
+
+The invariant checked is intra-function: when one function both
+increments a tracked counter and decrements it again, and statements
+that can raise (any call) sit between the two, the decrement must be
+inside a ``finally`` — otherwise the first raise leaks the slot and
+the balance never recovers (the exact bug shape of a stuck
+``_assigned`` node count or a serve replica that is forever "at
+capacity"). Cross-method protocols (``allocate()``/``free()``) are
+deliberately out of scope: their balance is a lifetime property the
+runtime sanitizer owns.
+
+Recognized forms::
+
+    self.n += 1 / self.n -= 1          (AugAssign)
+    self.n = self.n + 1 / ... - 1      (Assign rebind)
+    self.d[k] = self.d.get(k, 0) + 1   (dict-of-counters)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.raylint.core import Checker, Finding, register
+from ray_tpu.devtools.raylint.walker import ModuleInfo, \
+    walk_skipping_nested_defs
+
+
+def _attr_target(node: ast.AST) -> Optional[str]:
+    """'self.x' / 'cls.x' -> 'x'; bare module-global Name -> '::name'."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return "::" + node.id
+    return None
+
+
+def _counter_ops(funcnode) -> List[Tuple[str, int, int]]:
+    """All counter ops in a function (skipping nested defs):
+    [(name, +1|-1, lineno)]."""
+    ops: List[Tuple[str, int, int]] = []
+    for node in walk_skipping_nested_defs(funcnode.body):
+        if isinstance(node, ast.AugAssign):
+            name = _attr_target(node.target)
+            if name is None:
+                continue
+            if isinstance(node.op, ast.Add):
+                ops.append((name, +1, node.lineno))
+            elif isinstance(node.op, ast.Sub):
+                ops.append((name, -1, node.lineno))
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.BinOp) and \
+                isinstance(node.value.op, (ast.Add, ast.Sub)):
+            sign = +1 if isinstance(node.value.op, ast.Add) else -1
+            for target in node.targets:
+                # self.n = self.n +/- k
+                name = _attr_target(target)
+                if name is not None and \
+                        _attr_target(node.value.left) == name:
+                    ops.append((name, sign, node.lineno))
+                    continue
+                # self.d[k] = self.d.get(k, 0) +/- 1
+                if isinstance(target, ast.Subscript):
+                    dname = _attr_target(target.value)
+                    if dname is None:
+                        continue
+                    left = node.value.left
+                    if isinstance(left, ast.Call) and \
+                            isinstance(left.func, ast.Attribute) and \
+                            left.func.attr == "get" and \
+                            _attr_target(left.func.value) == dname:
+                        ops.append((dname, sign, node.lineno))
+    return ops
+
+
+@register
+class CounterBalance(Checker):
+    name = "counter-balance"
+    description = ("tracked counter increments whose decrement is not "
+                   "exception-safe")
+
+    def run(self, modules: List[ModuleInfo], ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in modules:
+            self._run_module(mod, findings)
+        return findings
+
+    def _run_module(self, mod: ModuleInfo, findings: List[Finding]):
+        # which counters are tracked, per owning scope (class or module)
+        per_owner_signs: Dict[Tuple[Optional[str], str], Set[int]] = {}
+        func_ops: Dict = {}
+        for funcnode, qual, classqual in mod.functions:
+            ops = _counter_ops(funcnode)
+            func_ops[funcnode] = ops
+            for name, sign, _ in ops:
+                owner = None if name.startswith("::") else classqual
+                per_owner_signs.setdefault((owner, name), set()).add(sign)
+        tracked = {key for key, signs in per_owner_signs.items()
+                   if signs == {+1, -1}}
+
+        for funcnode, qual, classqual in mod.functions:
+            ops = func_ops[funcnode]
+            for name, sign, lineno in ops:
+                if sign != +1:
+                    continue
+                owner = None if name.startswith("::") else classqual
+                if (owner, name) not in tracked:
+                    continue
+                decs = [ln for n, s, ln in ops
+                        if n == name and s == -1 and ln > lineno]
+                if not decs:
+                    # no decrement later in this function: cross-method
+                    # protocol (alloc/free) — out of static scope
+                    continue
+                if self._has_protected_dec(mod, funcnode, name):
+                    continue
+                first_dec = min(decs)
+                if not self._risky_between(mod, funcnode, lineno,
+                                           first_dec):
+                    continue
+                display = name[2:] if name.startswith("::") else \
+                    f"self.{name}"
+                findings.append(Finding(
+                    check=self.name, path=mod.relpath, line=lineno,
+                    scope=qual, detail=f"unbalanced:{name.lstrip(':')}",
+                    message=(
+                        f"{display} incremented here but the paired "
+                        f"decrement (line {first_dec}) is not in a "
+                        f"finally: an exception between them leaks the "
+                        f"count for good")))
+
+    def _has_protected_dec(self, mod: ModuleInfo, funcnode,
+                           name: str) -> bool:
+        """True if any decrement of ``name`` in this function sits in a
+        ``finally`` block."""
+        for node in walk_skipping_nested_defs(funcnode.body):
+            if not isinstance(node, ast.Try):
+                continue
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.AugAssign) and \
+                            isinstance(sub.op, ast.Sub) and \
+                            _attr_target(sub.target) == name:
+                        return True
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.BinOp) and \
+                            isinstance(sub.value.op, ast.Sub):
+                        for t in sub.targets:
+                            if _attr_target(t) == name or (
+                                    isinstance(t, ast.Subscript) and
+                                    _attr_target(t.value) == name):
+                                return True
+        return False
+
+    @staticmethod
+    def _risky_between(mod: ModuleInfo, funcnode, start: int,
+                       end: int) -> bool:
+        """Any call strictly between the two line numbers can raise AND
+        propagate — a call whose enclosing ``try`` has a broad handler
+        that swallows (no re-raise) cannot reach the decrement-skipping
+        path."""
+        for node in walk_skipping_nested_defs(funcnode.body):
+            if not (isinstance(node, ast.Call) and
+                    start < getattr(node, "lineno", start) < end):
+                continue
+            if CounterBalance._swallowed_by_broad_handler(mod, funcnode,
+                                                          node):
+                continue
+            return True
+        return False
+
+    @staticmethod
+    def _swallowed_by_broad_handler(mod: ModuleInfo, funcnode,
+                                    call: ast.Call) -> bool:
+        prev: ast.AST = call
+        cur = mod.parent.get(call)
+        while cur is not None and cur is not funcnode:
+            if isinstance(cur, ast.Try) and any(
+                    n is prev or _contains(n, prev) for n in cur.body):
+                for handler in cur.handlers:
+                    t = handler.type
+                    broad = t is None or (
+                        isinstance(t, ast.Name) and
+                        t.id in ("Exception", "BaseException"))
+                    if broad and not any(
+                            isinstance(n, ast.Raise)
+                            for n in ast.walk(handler)):
+                        return True
+            prev = cur
+            cur = mod.parent.get(cur)
+        return False
+
+
+def _contains(tree: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(tree))

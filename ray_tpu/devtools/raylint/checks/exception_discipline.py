@@ -1,0 +1,125 @@
+"""exception-discipline: daemon/server loops must not swallow
+exceptions blind.
+
+Scope: ``except``/``except Exception``/``except BaseException``
+handlers that are either (a) lexically inside a ``while`` loop, or
+(b) anywhere in a function whose name marks it as a daemon/server
+loop (``*_loop``, ``*_pump``, ``*_monitor``, ``serve*``, ...). A
+swallowed exception elsewhere loses one operation; inside a daemon
+loop it loses *every future iteration's* errors — the loop spins on
+silently with corrupt state, which is how a dead reporter thread goes
+unnoticed for a week.
+
+A handler passes if it does any of: re-``raise``, call something that
+logs (``logger.*``, ``logging.*``, ``print``, ``report``, ``*warn*``,
+``*error*``...), or *use the caught exception object* (``as exc`` and
+``exc`` referenced — routing the error into a slot/reply/typed
+``ray_tpu.exceptions`` wrapper counts as handling it). Only the
+handlers that drop the error on the floor fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ray_tpu.devtools.raylint.core import Checker, Finding, register
+from ray_tpu.devtools.raylint.walker import ModuleInfo, \
+    walk_skipping_nested_defs
+
+LOOP_NAME_RE = re.compile(
+    r"(loop|serve_forever|_pump|pump_|_monitor|monitor_|_watch(er)?$"
+    r"|daemon|_poll|poll_|heartbeat|_reporter|_flusher|_dispatch$)",
+    re.IGNORECASE)
+
+_LOG_RECEIVERS = {"logger", "logging", "log", "_log", "warnings"}
+_LOG_FUNC_RE = re.compile(
+    r"(^print$|^report$|log|warn|error|exception|debug|info|critical"
+    r"|perror)", re.IGNORECASE)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    """Name of the broad type caught, or None if the handler is typed."""
+    t = handler.type
+    if t is None:
+        return "bare"
+    names = []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        if isinstance(e, ast.Attribute):
+            names.append(e.attr)
+        elif isinstance(e, ast.Name):
+            names.append(e.id)
+    broad = [n for n in names if n in _BROAD]
+    return broad[0] if broad else None
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name  # "as exc" name, or None
+    for node in walk_skipping_nested_defs(handler.body):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            fname = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else "")
+            if _LOG_FUNC_RE.search(fname):
+                return True
+            recv = func.value if isinstance(func, ast.Attribute) else None
+            while isinstance(recv, ast.Attribute):
+                recv = recv.value
+            if isinstance(recv, ast.Name) and recv.id in _LOG_RECEIVERS:
+                return True
+    return False
+
+
+@register
+class ExceptionDiscipline(Checker):
+    name = "exception-discipline"
+    description = ("broad excepts in daemon/server loops that neither "
+                   "log, re-raise, nor use the caught exception")
+
+    def run(self, modules: List[ModuleInfo], ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in modules:
+            for funcnode, qual, classqual in mod.functions:
+                loopy_fn = bool(LOOP_NAME_RE.search(funcnode.name))
+                # handlers inside while loops, or anywhere in loop-named
+                # functions
+                while_ranges = [
+                    n for n in walk_skipping_nested_defs(funcnode.body)
+                    if isinstance(n, ast.While)]
+                handlers = []
+                seen = set()
+                for w in while_ranges:
+                    for n in walk_skipping_nested_defs(w.body):
+                        if isinstance(n, ast.ExceptHandler) and \
+                                id(n) not in seen:
+                            seen.add(id(n))
+                            handlers.append(n)
+                if loopy_fn:
+                    for n in walk_skipping_nested_defs(funcnode.body):
+                        if isinstance(n, ast.ExceptHandler) and \
+                                id(n) not in seen:
+                            seen.add(id(n))
+                            handlers.append(n)
+                for handler in handlers:
+                    broad = _is_broad(handler)
+                    if broad is None or _handles(handler):
+                        continue
+                    findings.append(Finding(
+                        check=self.name, path=mod.relpath,
+                        line=handler.lineno, scope=qual,
+                        detail=f"swallow:{broad}",
+                        message=(
+                            f"{'bare except' if broad == 'bare' else f'except {broad}'} "
+                            f"in a daemon/server loop swallows the error "
+                            f"without logging, re-raising, or using it — "
+                            f"the loop spins on blind; log it or raise a "
+                            f"typed ray_tpu.exceptions error")))
+        return findings

@@ -1,0 +1,352 @@
+"""lock-discipline: no blocking calls while holding a lock, and the
+static lock-order graph must be acyclic.
+
+Two sub-passes over the same walk:
+
+1. **Blocking-under-lock.** Inside a ``with <lock>:`` body (without
+   descending into nested defs — closures run later), any call that
+   blocks on I/O or another thread is flagged: ``time.sleep``,
+   subprocess spawns, socket ops (``recv``/``sendall``/``accept``/
+   ``connect``), the framed-transport verbs (``send``, ``send_many``,
+   ``pull``, ``pull_retrying``, ``call``/``call_many`` on peer pools,
+   the head-client ``_request``/``_dial`` round trips), future
+   ``.result()``, ``Event.wait()`` and ``Thread.join()``.
+   ``Condition.wait()`` on the *held* condition is exempt — it
+   releases the lock by contract.
+
+   Blocking propagates one call level: ``self.meth()`` under a held
+   lock is flagged when ``meth``'s own body contains a direct blocking
+   call — EXCEPT when the callee's name ends in ``_locked``, the
+   project convention for "intentionally called with the lock held"
+   (leaf I/O-serialization helpers like the transport's
+   ``_send_buffers_locked``).
+
+2. **Lock-order graph.** Acquiring lock B while holding lock A adds
+   the edge A→B; so does calling (one level deep, same class) a method
+   whose body acquires B. Cycles in the cross-module graph are
+   reported once per strongly-connected component — the static twin of
+   ``util.sanitizer``'s runtime lock-order watcher.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.raylint.core import Checker, Finding, register
+from ray_tpu.devtools.raylint.walker import ModuleInfo
+
+# Fully-resolved dotted names that block.
+BLOCKING_CANONICALS = {
+    "time.sleep",
+    "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+}
+# Method names (attribute calls) that block in this codebase: socket
+# verbs, framed-transport verbs, peer-pool RPCs, future redemption.
+BLOCKING_METHODS = {
+    "recv", "recv_into", "recvmsg", "sendall", "sendmsg", "accept",
+    "send", "send_many", "pull", "pull_retrying", "call", "call_many",
+    "result", "_request", "_request_result", "_dial",
+}
+# Bare-name calls (``from transport import connect``) that block.
+BLOCKING_NAMES = {
+    "connect", "create_connection", "sleep",
+}
+# Receivers whose ``send``/``call`` is NOT a wire write (queues,
+# generators): if the raw receiver spelling ends with one of these the
+# method is skipped. Kept small; suppressions cover the rest.
+_NONBLOCKING_RECEIVER_HINTS = ("queue", "_q", "gen", "generator")
+
+
+def _edge_key(a: str, b: str) -> Tuple[str, str]:
+    return (a, b)
+
+
+@register
+class LockDiscipline(Checker):
+    name = "lock-discipline"
+    description = ("blocking calls under a held lock; lock-order graph "
+                   "cycle detection")
+
+    def run(self, modules: List[ModuleInfo], ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        # (a, b) -> (path, line, scope) of the first edge site
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for mod in modules:
+            self._run_module(mod, findings, edges)
+        findings.extend(self._cycle_findings(edges))
+        return findings
+
+    # ------------------------------------------------------------ per-module
+    def _run_module(self, mod: ModuleInfo, findings: List[Finding],
+                    edges: Dict) -> None:
+        # Locks each method acquires directly at any depth of its own
+        # body — feeds the one-level interprocedural order edges.
+        direct_acq: Dict[str, Set[str]] = {}
+        for funcnode, qual, classqual in mod.functions:
+            acq: Set[str] = set()
+            for node in ast.walk(funcnode):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        sym = mod.lock_expr_symbol(item.context_expr,
+                                                   funcnode)
+                        if sym is not None:
+                            acq.add(sym[0])
+            if acq:
+                direct_acq[qual] = acq
+
+        # Methods whose own body blocks directly — feeds the one-level
+        # blocking propagation for self.meth() calls under a held lock.
+        method_blocking: Dict[str, str] = {}
+        for funcnode, qual, classqual in mod.functions:
+            for node in ast.walk(funcnode):
+                if isinstance(node, ast.Call):
+                    b = self._blocking_name(mod, funcnode, node, [])
+                    if b is not None:
+                        method_blocking[qual] = b
+                        break
+
+        for funcnode, qual, classqual in mod.functions:
+            self._walk_function(mod, funcnode, qual, classqual,
+                                direct_acq, method_blocking, findings,
+                                edges)
+
+    def _walk_function(self, mod: ModuleInfo, funcnode, qual: str,
+                       classqual: Optional[str], direct_acq: Dict,
+                       method_blocking: Dict,
+                       findings: List[Finding], edges: Dict) -> None:
+
+        def visit(node: ast.AST, held: List[Tuple[str, str]]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return  # nested defs execute outside this lock region
+            if isinstance(node, ast.With):
+                acquired: List[Tuple[str, str]] = []
+                for item in node.items:
+                    # context expressions evaluate before acquisition
+                    visit(item.context_expr, held)
+                    sym = mod.lock_expr_symbol(item.context_expr, funcnode)
+                    if sym is not None:
+                        acquired.append(sym)
+                for sym, kind in acquired:
+                    for held_sym, _ in held:
+                        if held_sym != sym:
+                            edges.setdefault(
+                                _edge_key(held_sym, sym),
+                                (mod.relpath, node.lineno, qual))
+                        elif kind == "lock":
+                            findings.append(Finding(
+                                check=self.name, path=mod.relpath,
+                                line=node.lineno, scope=qual,
+                                detail=f"self-deadlock:{_short(sym)}",
+                                message=(
+                                    f"non-reentrant lock {_short(sym)} "
+                                    f"re-acquired while already held — "
+                                    f"guaranteed deadlock")))
+                new_held = held + acquired if acquired else held
+                for child in node.body:
+                    visit(child, new_held)
+                return
+            if held and isinstance(node, ast.Call):
+                self._check_blocking(mod, funcnode, node, qual, classqual,
+                                     held, method_blocking, findings)
+                self._call_edges(mod, node, qual, classqual, held,
+                                 direct_acq, edges)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in funcnode.body:
+            visit(stmt, [])
+
+    # --------------------------------------------------------- blocking calls
+    def _blocking_name(self, mod: ModuleInfo, funcnode, call: ast.Call,
+                       held) -> Optional[str]:
+        """Display name when ``call`` blocks directly, else None. With
+        ``held`` empty (the precompute pass) Condition.wait always
+        counts — a caller holding any *other* lock would stall on it."""
+        canonical = mod.canonical(call.func)
+        last = canonical.rsplit(".", 1)[-1]
+        if canonical in BLOCKING_CANONICALS or \
+                canonical.startswith("subprocess."):
+            return canonical
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            recv_kind = self._receiver_kind(mod, funcnode, call.func.value)
+            if attr == "wait":
+                # Condition.wait on the HELD condition releases it — the
+                # sanctioned blocking idiom. Event.wait / a different
+                # condition's wait under a held lock blocks for real.
+                recv_sym = mod.lock_expr_symbol(call.func.value, funcnode)
+                if recv_sym is not None:
+                    if not any(s == recv_sym[0] for s, _ in held):
+                        return f"{_raw(call.func.value)}.wait"
+                elif recv_kind == "event":
+                    return f"{_raw(call.func.value)}.wait"
+            elif attr == "join":
+                if recv_kind == "thread":
+                    return f"{_raw(call.func.value)}.join"
+            elif attr in BLOCKING_METHODS:
+                raw = _raw(call.func.value)
+                if not any(raw.lower().endswith(h)
+                           for h in _NONBLOCKING_RECEIVER_HINTS):
+                    return f"{raw}.{attr}"
+            return None
+        if isinstance(call.func, ast.Name) and last in BLOCKING_NAMES:
+            return canonical
+        return None
+
+    def _check_blocking(self, mod: ModuleInfo, funcnode, call: ast.Call,
+                        qual: str, classqual: Optional[str], held,
+                        method_blocking: Dict,
+                        findings: List[Finding]) -> None:
+        held_names = ", ".join(_short(s) for s, _ in held)
+        blocked = self._blocking_name(mod, funcnode, call, held)
+        via = None
+        if blocked is None and classqual is not None and \
+                isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name) and \
+                call.func.value.id in ("self", "cls") and \
+                not call.func.attr.endswith("_locked"):
+            # One-level propagation: self.meth() whose body blocks.
+            # ``*_locked`` helpers are exempt by convention — they exist
+            # to run under the lock.
+            via = method_blocking.get(f"{classqual}.{call.func.attr}")
+            if via is not None:
+                blocked = f"self.{call.func.attr}"
+
+        if blocked is not None:
+            detail = f"blocking:{blocked.rsplit('.', 1)[-1]}"
+            inner = f" (it calls {via}())" if via else ""
+            findings.append(Finding(
+                check=self.name, path=mod.relpath, line=call.lineno,
+                scope=qual, detail=detail,
+                message=(f"blocking call {blocked}(){inner} while holding "
+                         f"{held_names} — every other thread contending "
+                         f"for the lock stalls behind this I/O")))
+
+    def _receiver_kind(self, mod: ModuleInfo, funcnode,
+                       recv: ast.AST) -> Optional[str]:
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id in ("self", "cls"):
+            return mod.attr_kind(mod.enclosing_class(recv), recv.attr)
+        if isinstance(recv, ast.Name):
+            return mod.name_kind(funcnode, recv.id)
+        return None
+
+    # ------------------------------------------------------------ order graph
+    def _call_edges(self, mod: ModuleInfo, call: ast.Call, qual: str,
+                    classqual: Optional[str], held, direct_acq: Dict,
+                    edges: Dict) -> None:
+        if classqual is None or not isinstance(call.func, ast.Attribute):
+            return
+        recv = call.func.value
+        if not (isinstance(recv, ast.Name) and recv.id in ("self", "cls")):
+            return
+        callee = f"{classqual}.{call.func.attr}"
+        for target_sym in sorted(direct_acq.get(callee, ())):
+            for held_sym, _ in held:
+                if held_sym != target_sym:
+                    edges.setdefault(
+                        _edge_key(held_sym, target_sym),
+                        (mod.relpath, call.lineno, qual))
+
+    def _cycle_findings(self, edges: Dict) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        sccs = _tarjan(graph)
+        findings: List[Finding] = []
+        for scc in sccs:
+            if len(scc) < 2:
+                # single nodes cycle only via an explicit self-edge,
+                # which the With walk reports as self-deadlock already
+                continue
+            cyc = sorted(scc)
+            # anchor the finding at the lexicographically-first edge
+            # inside the component so its id and site are stable
+            sites = sorted(
+                (edges[(a, b)], (a, b))
+                for a in cyc for b in graph.get(a, ())
+                if b in scc and (a, b) in edges)
+            (path, line, scope), _ = sites[0]
+            findings.append(Finding(
+                check=self.name, path=path, line=line, scope=scope,
+                detail="lock-order-cycle:" + "->".join(
+                    _short(n) for n in cyc),
+                message=(
+                    f"lock-order cycle between {', '.join(_short(n) for n in cyc)}: "
+                    f"two threads taking these locks in opposite order "
+                    f"deadlock; impose one global order")))
+        return findings
+
+
+def _short(symbol: str) -> str:
+    parts = symbol.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else symbol
+
+
+def _raw(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Iterative Tarjan SCC — deterministic over sorted adjacency."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str):
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+    return sccs

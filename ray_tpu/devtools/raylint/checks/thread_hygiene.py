@@ -1,0 +1,113 @@
+"""thread-hygiene: every ``threading.Thread`` is either a daemon or
+joined on some shutdown path.
+
+A non-daemon thread with no ``join`` keeps the interpreter alive after
+``main`` returns (hung test runs, zombie drivers); one *with* a join
+but created as non-daemon is a deliberate lifecycle choice. The pass
+accepts a thread if any of:
+
+- ``daemon=True`` at construction;
+- ``t.daemon = True`` / ``t.setDaemon(True)`` before start;
+- assigned to ``self.X`` and ``self.X.join(...)`` appears anywhere in
+  the same class (the shutdown path), or a bare ``X.join`` anywhere in
+  the module;
+- a local ``t = Thread(...)`` with ``t.join()`` in the same function.
+
+Anything else — including a fire-and-forget
+``threading.Thread(...).start()`` — fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu.devtools.raylint.core import Checker, Finding, register
+from ray_tpu.devtools.raylint.walker import ModuleInfo
+
+
+def _daemon_kwarg(call: ast.Call) -> Optional[bool]:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return None
+
+
+def _joins_in(tree_nodes, attr: Optional[str], name: Optional[str]) -> bool:
+    """Any ``<recv>.join(`` / ``<recv>.daemon = True`` /
+    ``<recv>.setDaemon(True)`` where recv is ``self.<attr>`` or bare
+    ``<name>``."""
+    def recv_matches(recv: ast.AST) -> bool:
+        if attr is not None and isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id in ("self", "cls") and recv.attr == attr:
+            return True
+        if name is not None and isinstance(recv, ast.Name) and \
+                recv.id == name:
+            return True
+        return False
+
+    for node in tree_nodes:
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("join", "setDaemon") and \
+                recv_matches(node.func.value):
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and recv_matches(t.value):
+                    return True
+    return False
+
+
+@register
+class ThreadHygiene(Checker):
+    name = "thread-hygiene"
+    description = "non-daemon threads with no join on a shutdown path"
+
+    def run(self, modules: List[ModuleInfo], ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in modules:
+            module_nodes = list(ast.walk(mod.tree))
+            for node in module_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                if not mod.canonical(node.func).endswith(
+                        "threading.Thread"):
+                    continue
+                if _daemon_kwarg(node) is True:
+                    continue
+                scope = mod.scope_name(node)
+                parent = mod.parent.get(node)
+                target_attr = target_name = None
+                if isinstance(parent, ast.Assign) and \
+                        len(parent.targets) == 1:
+                    t = parent.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in ("self", "cls"):
+                        target_attr = t.attr
+                    elif isinstance(t, ast.Name):
+                        target_name = t.id
+                if target_attr is not None or target_name is not None:
+                    # class scope for self.X, function scope for locals —
+                    # fall back to whole module (helpers may join it)
+                    if _joins_in(module_nodes, target_attr, target_name):
+                        continue
+                    what = f"self.{target_attr}" if target_attr else \
+                        target_name
+                    msg = (f"non-daemon thread {what} is never joined "
+                           f"and never marked daemon — it pins the "
+                           f"process at exit; join it on the shutdown "
+                           f"path or pass daemon=True")
+                else:
+                    msg = ("fire-and-forget non-daemon Thread — nothing "
+                           "can ever join it; pass daemon=True or keep "
+                           "a handle and join on shutdown")
+                findings.append(Finding(
+                    check=self.name, path=mod.relpath, line=node.lineno,
+                    scope=scope,
+                    detail=f"unjoined:{target_attr or target_name or 'anonymous'}",
+                    message=msg))
+        return findings

@@ -1,0 +1,137 @@
+"""raylint command line.
+
+Usage::
+
+    python scripts/raylint.py [paths...]        # gate against baseline
+    python scripts/raylint.py --json            # machine-readable report
+    python scripts/raylint.py --update-baseline # rewrite the baseline
+    python scripts/raylint.py --list-checks
+    python scripts/raylint.py --checks lock-discipline,flag-hygiene
+    python scripts/raylint.py --show-baselined  # include baselined hits
+
+Exit codes: 0 clean (all findings baselined, no stale entries, within
+budget); 1 gate violation (new findings / stale baseline entries /
+budget exceeded / parse errors); 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ray_tpu.devtools.raylint import baseline as baseline_mod
+from ray_tpu.devtools.raylint.core import CHECKERS
+from ray_tpu.devtools.raylint.reporters import render_human, render_json
+from ray_tpu.devtools.raylint.runner import AnalysisContext, run_analysis
+
+DEFAULT_PATHS = ["ray_tpu"]
+DEFAULT_BASELINE = os.path.join("scripts", "raylint_baseline.json")
+
+
+def main(argv: Optional[List[str]] = None, root: Optional[str] = None) \
+        -> int:
+    parser = argparse.ArgumentParser(
+        prog="raylint", description="ray_tpu project-invariant static "
+        "analysis")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to analyze "
+                        "(default: ray_tpu/)")
+    parser.add_argument("--json", action="store_true",
+                        help="JSON report on stdout")
+    parser.add_argument("--checks", default=None,
+                        help="comma-separated subset of checks to run")
+    parser.add_argument("--list-checks", action="store_true")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline path (default {DEFAULT_BASELINE})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report and gate on "
+                        "every finding")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current "
+                        "findings (budget = count)")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="print baselined findings too")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for name in sorted(CHECKERS):
+            print(f"{name}: {CHECKERS[name].description}")
+        return 0
+
+    root = root or os.getcwd()
+    paths = args.paths or DEFAULT_PATHS
+    checks = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+        unknown = [c for c in checks if c not in CHECKERS]
+        if unknown:
+            print(f"unknown check(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(CHECKERS))})",
+                  file=sys.stderr)
+            return 2
+
+    result = run_analysis(paths, root, checks=checks,
+                          ctx=AnalysisContext(root=root))
+    ids = [f.fid for f in result.findings]
+
+    def in_selected(fid: str) -> bool:
+        return checks is None or fid.split(":", 1)[0] in checks
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.update_baseline:
+        # With --checks, entries belonging to checks that did not run
+        # are carried over untouched — a subset update must never drop
+        # another pass's baselined debt.
+        carried = []
+        if checks is not None:
+            carried = [fid for fid
+                       in baseline_mod.load(baseline_path)["findings"]
+                       if not in_selected(fid)]
+        data = baseline_mod.save(baseline_path, ids + carried)
+        print(f"raylint: baseline rewritten with "
+              f"{len(ids) + len(carried)} finding(s), "
+              f"budget={data['budget']} -> {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, stale, over = ids, [], False
+    else:
+        base = baseline_mod.load(baseline_path)
+        if checks is not None:
+            # Gate the subset against the subset's slice of the
+            # baseline: other checks' entries are neither stale nor in
+            # budget here. The subset budget is the global budget minus
+            # the carried entries, so a hand-shrunk budget still
+            # ratchets in subset mode.
+            subset = [fid for fid in base["findings"] if in_selected(fid)]
+            others = len(base["findings"]) - len(subset)
+            budget = int(base.get("budget", len(base["findings"])))
+            base = {"version": base.get("version", 1),
+                    "budget": max(0, budget - others),
+                    "findings": subset}
+        new, stale, over = baseline_mod.compare(ids, base)
+
+    if args.json:
+        print(render_json(result.findings, new, stale, result.n_files,
+                          result.elapsed_s))
+    else:
+        print(render_human(result.findings, new, stale, result.n_files,
+                           result.elapsed_s,
+                           baselined_shown=args.show_baselined))
+
+    failed = bool(new) or bool(stale) or over or bool(result.parse_errors)
+    if over:
+        print("raylint: FINDING COUNT EXCEEDS BASELINE BUDGET — the "
+              "baseline only ever shrinks; fix the new findings instead "
+              "of growing it", file=sys.stderr)
+    if new and not args.json:
+        print(f"raylint: {len(new)} non-baselined finding(s) — fix them "
+              f"or suppress with '# raylint: disable=<check>'",
+              file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Checker registry and the Finding model.
+
+A Finding's identity (``fid``) is deliberately **line-independent**:
+``check:path:scope:detail[#n]`` where ``scope`` is the enclosing
+qualified function/class name and ``detail`` names what fired (the
+blocking call, the counter, the flag). Unrelated edits that shift line
+numbers therefore do not churn the committed baseline; the ``#n``
+suffix disambiguates repeated identical sites within one scope in
+source order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Type
+
+
+@dataclass
+class Finding:
+    check: str
+    path: str          # repo-relative posix path
+    line: int
+    scope: str         # qualified enclosing scope ("Class.method", "<module>")
+    detail: str        # what fired: call name, counter name, flag name, ...
+    message: str
+    fid: str = field(default="")
+
+    def base_id(self) -> str:
+        return f"{self.check}:{self.path}:{self.scope}:{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check}] {self.message}"
+                f"  (id: {self.fid})")
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.fid, "check": self.check, "path": self.path,
+            "line": self.line, "scope": self.scope, "detail": self.detail,
+            "message": self.message,
+        }
+
+
+def assign_ids(findings: List[Finding]) -> List[Finding]:
+    """Stable-sort and number duplicate base ids in source order."""
+    findings = sorted(findings, key=lambda f: (f.path, f.line, f.check,
+                                               f.detail))
+    seen: Dict[str, int] = {}
+    for f in findings:
+        base = f.base_id()
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        f.fid = base if n == 0 else f"{base}#{n + 1}"
+    return findings
+
+
+class Checker:
+    """One analysis pass. Subclasses set ``name`` and implement ``run``
+    over the whole module set (passes like lock-order and flag-hygiene
+    need cross-module state, so the unit of work is the project)."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, modules, ctx) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+CHECKERS: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if not cls.name:
+        raise ValueError(f"checker {cls.__name__} has no name")
+    if cls.name in CHECKERS:
+        raise ValueError(f"checker {cls.name!r} registered twice")
+    CHECKERS[cls.name] = cls
+    return cls

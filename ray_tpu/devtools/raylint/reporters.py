@@ -1,0 +1,47 @@
+"""Human and JSON reporters for analysis results."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from ray_tpu.devtools.raylint.core import Finding
+
+
+def render_human(findings: List[Finding], new_ids, stale_ids,
+                 n_files: int, elapsed_s: float,
+                 baselined_shown: bool = False) -> str:
+    out: List[str] = []
+    new = set(new_ids)
+    shown = [f for f in findings if baselined_shown or f.fid in new]
+    for f in shown:
+        marker = "" if f.fid in new else " [baselined]"
+        out.append(f.render() + marker)
+    if stale_ids:
+        out.append("")
+        out.append("stale baseline entries (fixed findings — remove them "
+                   "from scripts/raylint_baseline.json):")
+        for fid in stale_ids:
+            out.append(f"  {fid}")
+    out.append("")
+    per_check: Dict[str, int] = {}
+    for f in findings:
+        per_check[f.check] = per_check.get(f.check, 0) + 1
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(per_check.items())) \
+        or "none"
+    out.append(
+        f"raylint: {len(findings)} finding(s) ({summary}) over {n_files} "
+        f"file(s) in {elapsed_s:.2f}s — {len(new)} new, "
+        f"{len(findings) - len(new)} baselined, {len(stale_ids)} stale")
+    return "\n".join(out)
+
+
+def render_json(findings: List[Finding], new_ids, stale_ids,
+                n_files: int, elapsed_s: float) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in findings],
+        "new": list(new_ids),
+        "stale_baseline": list(stale_ids),
+        "files": n_files,
+        "elapsed_s": round(elapsed_s, 3),
+    }, indent=1)

@@ -1,0 +1,71 @@
+"""Analysis driver: load modules once, run every registered checker,
+filter suppressions, assign stable ids."""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.devtools.raylint.core import CHECKERS, Finding, assign_ids
+from ray_tpu.devtools.raylint.walker import ModuleInfo, load_modules
+
+# Import for registration side effects.
+from ray_tpu.devtools.raylint import checks as _checks  # noqa: F401
+
+
+@dataclass
+class AnalysisContext:
+    root: str
+    readme_path: Optional[str] = None
+    config_relpath: str = "ray_tpu/_private/config.py"
+    extra: Dict = field(default_factory=dict)
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    parse_errors: List
+    n_files: int
+    elapsed_s: float
+
+
+def run_analysis(paths: Sequence[str], root: str,
+                 checks: Optional[Sequence[str]] = None,
+                 ctx: Optional[AnalysisContext] = None) -> AnalysisResult:
+    t0 = time.monotonic()
+    if ctx is None:
+        ctx = AnalysisContext(root=root)
+    if ctx.readme_path is None:
+        readme = os.path.join(root, "README.md")
+        ctx.readme_path = readme if os.path.exists(readme) else None
+    modules, parse_errors = load_modules(paths, root)
+    by_path: Dict[str, ModuleInfo] = {m.relpath: m for m in modules}
+
+    findings: List[Finding] = []
+    for relpath, message in parse_errors:
+        findings.append(Finding(
+            check="parse-error", path=relpath, line=1, scope="<module>",
+            detail="syntax", message=f"file does not parse: {message}"))
+
+    selected = checks if checks is not None else sorted(CHECKERS)
+    for name in selected:
+        checker_cls = CHECKERS.get(name)
+        if checker_cls is None:
+            raise ValueError(f"unknown check {name!r} "
+                             f"(known: {sorted(CHECKERS)})")
+        findings.extend(checker_cls().run(modules, ctx))
+
+    kept = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.is_suppressed(f.check, f.line):
+            continue
+        kept.append(f)
+    return AnalysisResult(
+        findings=assign_ids(kept),
+        parse_errors=parse_errors,
+        n_files=len(modules),
+        elapsed_s=time.monotonic() - t0,
+    )

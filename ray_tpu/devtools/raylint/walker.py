@@ -1,0 +1,377 @@
+"""Shared module walker: parsing, suppression comments, import-alias
+and self-attribute resolution.
+
+Every checker consumes ``ModuleInfo`` objects built here, so the tree
+is parsed exactly once per run. The walker resolves three things the
+passes all need:
+
+- **import aliases** — ``import threading as t`` / ``from threading
+  import Lock`` so a call site can be canonicalized to its dotted
+  origin (``threading.Lock``) regardless of spelling;
+- **attribute kinds** — ``self._lock = threading.Lock()`` (or
+  ``sanitizer.tracked_lock(...)``) records ``_lock`` as a lock
+  attribute of its class; same for Condition/Thread/Event, and for
+  module-level and function-local names;
+- **suppressions** — ``# raylint: disable=<check>[,<check>]`` trailing
+  on a line suppresses that line; on a comment-only line it suppresses
+  the next line. ``disable=all`` suppresses every check.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+# Canonical factory names (suffix-matched after alias resolution).
+# RLock is tracked as its own kind: re-entrant self-nesting is legal,
+# so the lock-order pass must not flag RLock self-loops.
+_LOCK_SUFFIXES = ("threading.Lock", "tracked_lock", "TrackedLock")
+_RLOCK_SUFFIXES = ("threading.RLock", "tracked_rlock", "TrackedRLock")
+_COND_SUFFIXES = ("threading.Condition", "tracked_condition",
+                  "TrackedCondition")
+_THREAD_SUFFIXES = ("threading.Thread",)
+_EVENT_SUFFIXES = ("threading.Event",)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+def _kind_of_factory(canonical: str) -> Optional[str]:
+    if not canonical:
+        return None
+    if any(canonical == s or canonical.endswith(s) for s in _RLOCK_SUFFIXES):
+        return "rlock"
+    if any(canonical == s or canonical.endswith(s) for s in _LOCK_SUFFIXES):
+        return "lock"
+    if any(canonical == s or canonical.endswith(s) for s in _COND_SUFFIXES):
+        return "condition"
+    if any(canonical == s or canonical.endswith(s)
+           for s in _THREAD_SUFFIXES):
+        return "thread"
+    if any(canonical == s or canonical.endswith(s) for s in _EVENT_SUFFIXES):
+        return "event"
+    return None
+
+
+class ModuleInfo:
+    """One parsed module plus everything the passes resolve from it."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.modname = self.relpath[:-3].replace("/", ".")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        self.import_aliases: Dict[str, str] = {}   # local -> dotted module
+        self.from_imports: Dict[str, str] = {}     # local -> module.attr
+        # scope resolution
+        self.scope_of: Dict[ast.AST, str] = {}     # def/class node -> qual
+        self.functions: List[Tuple[ast.AST, str, Optional[str]]] = []
+        # classqual -> {attr: kind}; kind in lock|condition|thread|event
+        self.class_attr_kinds: Dict[str, Dict[str, str]] = {}
+        # classqual -> {method name: funcnode}
+        self.class_methods: Dict[str, Dict[str, ast.AST]] = {}
+        self.module_kinds: Dict[str, str] = {}     # module-level name -> kind
+        # funcnode -> {local name: kind}
+        self.func_local_kinds: Dict[ast.AST, Dict[str, str]] = {}
+        # Condition(self._lock) WRAPS the lock: acquiring/waiting on the
+        # condition is acquiring/releasing that same lock. symbol -> symbol
+        self.condition_wraps: Dict[str, str] = {}
+        self.symbol_kinds: Dict[str, str] = {}     # lock symbol -> kind
+        self.suppressions: Dict[int, Set[str]] = {}
+
+        self._build_parents()
+        self._build_imports()
+        self._build_scopes()
+        self._build_kinds()
+        self._build_suppressions()
+
+    # ------------------------------------------------------------ building
+    def _build_parents(self):
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+    def _build_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def _build_scopes(self):
+        def visit(node, prefix, classqual):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    self.scope_of[child] = qual
+                    self.functions.append((child, qual, classqual))
+                    if classqual is not None and prefix == classqual + ".":
+                        self.class_methods.setdefault(
+                            classqual, {})[child.name] = child
+                    visit(child, qual + ".", classqual)
+                elif isinstance(child, ast.ClassDef):
+                    qual = f"{prefix}{child.name}"
+                    self.scope_of[child] = qual
+                    self.class_attr_kinds.setdefault(qual, {})
+                    self.class_methods.setdefault(qual, {})
+                    visit(child, qual + ".", qual)
+                else:
+                    visit(child, prefix, classqual)
+        visit(self.tree, "", None)
+
+    def _build_kinds(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            kind = _kind_of_factory(self.canonical(value.func))
+            if kind is None:
+                continue
+            for target in targets:
+                symbol = None
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id in ("self", "cls"):
+                    classqual = self.enclosing_class(node)
+                    if classqual is not None:
+                        self.class_attr_kinds.setdefault(
+                            classqual, {})[target.attr] = kind
+                        symbol = f"{self.modname}.{classqual}." \
+                                 f"{target.attr}"
+                elif isinstance(target, ast.Name):
+                    func = self.enclosing_function(node)
+                    if func is None:
+                        self.module_kinds[target.id] = kind
+                        symbol = f"{self.modname}.{target.id}"
+                    else:
+                        self.func_local_kinds.setdefault(
+                            func, {})[target.id] = kind
+                        scope = self.scope_of.get(func, "")
+                        symbol = f"{self.modname}.{scope}.{target.id}"
+                if symbol is None:
+                    continue
+                self.symbol_kinds[symbol] = kind
+                if kind == "condition" and value.args:
+                    wrapped = self._symbol_of_expr(value.args[0], node)
+                    if wrapped is not None:
+                        self.condition_wraps[symbol] = wrapped
+
+    def _symbol_of_expr(self, expr: ast.AST, at: ast.AST) -> Optional[str]:
+        """Symbol for a lock-valued expression at an assignment site
+        (used for Condition(<lock>) wrap targets)."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            classqual = self.enclosing_class(at)
+            if classqual is not None:
+                return f"{self.modname}.{classqual}.{expr.attr}"
+        elif isinstance(expr, ast.Name):
+            func = self.enclosing_function(at)
+            if func is None:
+                return f"{self.modname}.{expr.id}"
+            scope = self.scope_of.get(func, "")
+            kinds = self.func_local_kinds.get(func, {})
+            if expr.id in kinds:
+                return f"{self.modname}.{scope}.{expr.id}"
+            return f"{self.modname}.{expr.id}"
+        return None
+
+    def resolve_lock_alias(self, symbol: str) -> str:
+        """Follow Condition->wrapped-lock aliases to the canonical
+        underlying lock symbol."""
+        seen = set()
+        while symbol in self.condition_wraps and symbol not in seen:
+            seen.add(symbol)
+            symbol = self.condition_wraps[symbol]
+        return symbol
+
+    def _build_suppressions(self):
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            checks = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            checks = {"*" if c == "all" else c for c in checks}
+            if line.strip().startswith("#"):
+                # Comment-only line: applies to the next source line.
+                self.suppressions.setdefault(i + 1, set()).update(checks)
+            else:
+                self.suppressions.setdefault(i, set()).update(checks)
+
+    # ----------------------------------------------------------- resolution
+    def canonical(self, node: ast.AST) -> str:
+        """Dotted canonical name of a Name/Attribute chain, resolving
+        import aliases: ``t.sleep`` -> ``time.sleep`` under ``import
+        time as t``; ``Lock`` -> ``threading.Lock`` under ``from
+        threading import Lock``. Unresolvable chains return the raw
+        dotted spelling (``self._conn.recv``)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            base = node.id
+            if base in self.from_imports:
+                parts.append(self.from_imports[base])
+            elif base in self.import_aliases:
+                parts.append(self.import_aliases[base])
+            else:
+                parts.append(base)
+        elif isinstance(node, ast.Call):
+            # chained call like threading.Thread(...).start — canonical
+            # of the call result is the factory itself
+            inner = self.canonical(node.func)
+            parts.append(f"{inner}()" if inner else "()")
+        else:
+            parts.append("?")
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[str]:
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return self.scope_of.get(cur)
+            cur = self.parent.get(cur)
+        return None
+
+    def scope_name(self, node: ast.AST) -> str:
+        """Qualified name of the scope enclosing ``node`` (itself if a
+        def/class), or ``<module>``."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                return self.scope_of.get(cur, cur.name)
+            cur = self.parent.get(cur)
+        return "<module>"
+
+    def attr_kind(self, classqual: Optional[str], attr: str) \
+            -> Optional[str]:
+        if classqual is None:
+            return None
+        return self.class_attr_kinds.get(classqual, {}).get(attr)
+
+    def name_kind(self, funcnode: Optional[ast.AST], name: str) \
+            -> Optional[str]:
+        """Kind of a bare name at a use site: function locals shadow
+        module globals."""
+        cur = funcnode
+        while cur is not None:
+            kinds = self.func_local_kinds.get(cur)
+            if kinds and name in kinds:
+                return kinds[name]
+            cur = self.enclosing_function(cur)
+        return self.module_kinds.get(name)
+
+    def lock_expr_symbol(self, expr: ast.AST, funcnode: Optional[ast.AST]) \
+            -> Optional[Tuple[str, str]]:
+        """If ``expr`` denotes a known lock/condition, return
+        ``(symbol, kind)`` where symbol is stable across the project
+        (``modname.Class.attr`` or ``modname.name``). A Condition that
+        wraps a lock resolves to the WRAPPED lock's symbol — they are
+        one mutex."""
+        symbol = kind = None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            classqual = self.enclosing_class(expr)
+            kind = self.attr_kind(classqual, expr.attr)
+            if kind in ("lock", "rlock", "condition"):
+                symbol = f"{self.modname}.{classqual}.{expr.attr}"
+        elif isinstance(expr, ast.Name):
+            kind = self.name_kind(funcnode, expr.id)
+            if kind in ("lock", "rlock", "condition"):
+                scope = ""
+                if funcnode is not None:
+                    kinds = self.func_local_kinds.get(funcnode, {})
+                    if expr.id in kinds:
+                        scope = self.scope_of.get(funcnode, "") + "."
+                symbol = f"{self.modname}.{scope}{expr.id}"
+        if symbol is None:
+            return None
+        resolved = self.resolve_lock_alias(symbol)
+        if resolved != symbol:
+            kind = self.symbol_kinds.get(resolved, kind)
+        return resolved, kind
+
+    def is_suppressed(self, check: str, line: int) -> bool:
+        checks = self.suppressions.get(line)
+        if not checks:
+            return False
+        return check in checks or "*" in checks
+
+
+# ------------------------------------------------------------- collection
+def iter_py_files(paths: Iterable[str], root: str) -> Iterator[str]:
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_modules(paths: Iterable[str], root: str) \
+        -> Tuple[List[ModuleInfo], List[Tuple[str, str]]]:
+    """Parse every .py under ``paths``. Returns (modules, parse_errors)
+    where parse_errors is [(relpath, message)]."""
+    modules: List[ModuleInfo] = []
+    errors: List[Tuple[str, str]] = []
+    for path in iter_py_files(paths, root):
+        relpath = os.path.relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            errors.append((relpath.replace(os.sep, "/"), str(exc)))
+            continue
+        modules.append(ModuleInfo(path, relpath, source, tree))
+    return modules, errors
+
+
+def walk_skipping_nested_defs(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    definitions or lambdas (their bodies execute later, outside the
+    lexical context being analyzed)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -45,8 +45,10 @@ def initialize(coordinator_address: Optional[str] = None,
     With one process (or no coordinator configured) this is a local no-op
     — the single-host paths are unchanged.
     """
-    coordinator_address = coordinator_address or os.environ.get(
-        "RAY_TPU_COORDINATOR_ADDRESS")
+    from ray_tpu._private.config import GlobalConfig
+
+    coordinator_address = coordinator_address or \
+        GlobalConfig.coordinator_address or None
     num_processes = num_processes if num_processes is not None else int(
         os.environ.get("RAY_TPU_NUM_PROCESSES", "1"))
     process_id = process_id if process_id is not None else int(

@@ -45,9 +45,10 @@ _apply_lock = threading.Lock()
 
 
 def _cache_root() -> str:
-    return os.environ.get(
-        "RAY_TPU_RUNTIME_ENV_CACHE",
-        os.path.expanduser("~/.cache/ray_tpu/runtime_envs"))
+    from ray_tpu._private.config import GlobalConfig
+
+    return GlobalConfig.runtime_env_cache or \
+        os.path.expanduser("~/.cache/ray_tpu/runtime_envs")
 
 
 def pip_env_key(pip: List[str], builder: str = "pip") -> str:

@@ -15,7 +15,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.log import get_logger
 from ray_tpu.serve.router import ReplicaSet
+
+log = get_logger(__name__)
 
 
 @dataclass
@@ -104,8 +107,9 @@ class ServeController:
             try:
                 self._autoscale()
                 self._reconcile_once()
-            except Exception:  # noqa: BLE001 — keep the controller alive
-                pass
+            except Exception as exc:  # keep the controller alive
+                log.warning("serve reconcile pass failed; controller "
+                            "continues: %r", exc)
 
     # ---------------------------------------------------- prefix telemetry
     _PREFIX_POLL_INTERVAL_S = 1.0
@@ -114,8 +118,9 @@ class ServeController:
         while not self._stop.wait(0.5):
             try:
                 self._poll_prefix_digests()
-            except Exception:  # noqa: BLE001 — telemetry best-effort
-                pass
+            except Exception as exc:  # telemetry best-effort
+                log.debug("prefix-digest poll failed; routing uses "
+                          "stale overlap scores: %r", exc)
 
     def _poll_prefix_digests(self):
         """Refresh each prefix-capable deployment's replica digest
@@ -137,8 +142,9 @@ class ServeController:
                     report = ray_tpu.get(ref, timeout=2.0)
                     info.replica_set.update_prefix_digest(
                         id(r), report["block_size"], report["digests"])
-                except Exception:  # noqa: BLE001 — telemetry best-effort
-                    pass
+                except Exception as exc:  # telemetry best-effort
+                    log.debug("replica prefix_digest probe failed: %r",
+                              exc)
 
     def _reconcile_once(self):
         with self._lock:

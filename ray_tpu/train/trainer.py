@@ -18,6 +18,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import collective
+from ray_tpu._private.log import get_logger
+
+log = get_logger(__name__)
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.session import TrainContext, _set_context
@@ -241,8 +244,9 @@ class JaxTrainer:
                     for cb in self._run_config.callbacks:
                         try:  # live stream; a logger bug must not fail
                             cb.on_result(metrics)  # the training group
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as exc:
+                            log.warning("train callback %r failed on a "
+                                        "result: %r", cb, exc)
                     if ckpt is not None:
                         latest_ckpt = self._persist(ckpt)
 

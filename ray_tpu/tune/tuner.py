@@ -20,7 +20,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.log import get_logger
 from ray_tpu.train.checkpoint import Checkpoint
+
+log = get_logger(__name__)
 from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
 from ray_tpu.tune.search_space import generate_variants
 
@@ -269,8 +272,9 @@ class Tuner:
                     try:
                         search_alg.on_trial_complete(
                             tid, trials[tid].metrics)
-                    except Exception:  # noqa: BLE001 — searcher bug
-                        pass
+                    except Exception as exc:  # searcher bug
+                        log.warning("search algorithm failed on trial "
+                                    "%s completion: %r", tid, exc)
         _drain()  # reports that raced with completion
         for key in worker.kv_keys(f"tune|{run_id}|".encode()):
             worker.kv_del(key)

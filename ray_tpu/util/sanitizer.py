@@ -17,6 +17,13 @@ checks them live when ``RAY_TPU_SANITIZE=1`` (or ``enable()``):
 - **Stall watchdog**: tasks stuck in the scheduler beyond a threshold
   with idle capacity — the observable shape of a host-side deadlock —
   are reported with their names.
+- **Lock-order watcher** (dynamic twin of raylint's static lock-order
+  pass): ``tracked_lock``/``tracked_rlock`` wrappers record, per
+  thread, which locks are held when another is acquired, building a
+  global lock-order graph. The first acquisition that would close a
+  cycle raises ``SanitizerError`` *before blocking* — surfacing the
+  A→B / B→A deadlock on the lucky interleaving instead of hanging on
+  the unlucky one.
 
 Violations raise ``SanitizerError`` by default (tests), or log when
 ``RAY_TPU_SANITIZE_MODE=warn`` (long-lived clusters).
@@ -62,6 +69,7 @@ def clear() -> None:
         _violations.clear()
     with channel_checker._lock:
         channel_checker._last.clear()
+    lock_order_watcher.reset()
 
 
 def report(kind: str, message: str, force_warn: bool = False) -> None:
@@ -128,6 +136,212 @@ class ChannelSequenceChecker:
 channel_checker = ChannelSequenceChecker()
 
 
+# --------------------------------------------------------- lock-order watcher
+class LockOrderWatcher:
+    """Runtime lock-order cycle detection over ``tracked_lock`` locks.
+
+    Each thread keeps its held-lock stack (thread-local); acquiring B
+    while holding A records the directed edge A→B in a process-global
+    graph. Before an acquisition that adds edges, the watcher searches
+    for a path from the new lock back to any currently-held one — such
+    a path plus the new edge is a cycle, i.e. two code paths take these
+    locks in opposite orders and the right interleaving deadlocks them.
+    The report fires on the FIRST order inversion, deterministically,
+    without needing the deadlock to actually happen."""
+
+    def __init__(self):
+        self._edges: Dict[str, set] = {}
+        self._graph_lock = threading.Lock()
+        self._tls = threading.local()
+        self._stacks: List[list] = []  # every thread's stack, for reset
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+            with self._graph_lock:
+                self._stacks.append(stack)
+        return stack
+
+    def reset(self) -> None:
+        """Test-boundary cleanup: drop the edge graph AND every
+        thread's held-stack (a stack entry surviving an enable()
+        toggle would otherwise poison later runs with false edges)."""
+        with self._graph_lock:
+            self._edges.clear()
+            for stack in self._stacks:
+                stack.clear()
+
+    def edges(self) -> Dict[str, set]:
+        with self._graph_lock:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def _path_to_any(self, start: str, targets: set) -> Optional[list]:
+        """DFS path start →* (any target) over the edge graph; caller
+        holds _graph_lock."""
+        seen = {start}
+        path = [start]
+
+        def dfs(node: str) -> bool:
+            for nxt in sorted(self._edges.get(node, ())):
+                if nxt in targets:
+                    path.append(nxt)
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    path.append(nxt)
+                    if dfs(nxt):
+                        return True
+                    path.pop()
+            return False
+
+        return path if dfs(start) else None
+
+    def on_acquire(self, name: str) -> None:
+        """Called BEFORE blocking on the underlying lock."""
+        stack = self._stack()
+        if stack:
+            held = set(stack)
+            with self._graph_lock:
+                for h in stack:
+                    if h != name:
+                        self._edges.setdefault(h, set()).add(name)
+                cycle = self._path_to_any(name, held) \
+                    if name not in held else [name, name]
+            if cycle is not None:
+                report(
+                    "lock-order-cycle",
+                    f"acquiring {name!r} while holding "
+                    f"{stack!r} closes the cycle "
+                    f"{' -> '.join(cycle)} -> {name!r} seen in the "
+                    f"opposite order elsewhere — two threads taking "
+                    f"these locks concurrently deadlock")
+        stack.append(name)
+
+    def on_acquired_failed(self, name: str) -> None:
+        """Non-blocking acquire that returned False: undo the stack
+        entry optimistically pushed by on_acquire."""
+        stack = self._stack()
+        if name in stack:
+            del stack[len(stack) - 1 - stack[::-1].index(name)]
+
+    def on_release(self, name: str) -> None:
+        stack = self._stack()
+        if name in stack:
+            del stack[len(stack) - 1 - stack[::-1].index(name)]
+
+
+lock_order_watcher = LockOrderWatcher()
+
+
+class TrackedLock:
+    """``threading.Lock``-compatible wrapper feeding the lock-order
+    watcher. When the sanitizer is disabled the overhead is one
+    ``enabled()`` check per acquire — cheap enough to wire into
+    control-plane locks permanently."""
+
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = self._factory()
+        self._tracked = threading.local()  # was THIS hold recorded?
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if enabled():
+            lock_order_watcher.on_acquire(self.name)
+            ok = self._lock.acquire(blocking, timeout)
+            if not ok:
+                lock_order_watcher.on_acquired_failed(self.name)
+            else:
+                self._tracked.held = True
+            return ok
+        return self._lock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lock.release()
+        # Pop keyed on whether the ACQUIRE was tracked, not on the
+        # current enabled() state: toggling the sanitizer off while a
+        # lock is held must not strand its stack entry (false edges —
+        # and false cycles — forever after).
+        if getattr(self._tracked, "held", False):
+            self._tracked.held = False
+            lock_order_watcher.on_release(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name!r} {self._lock!r}>"
+
+
+class TrackedRLock(TrackedLock):
+    """Re-entrant variant: repeated acquisition by the owner is legal
+    and is not an order edge — only the 0→1 transition records order,
+    only the 1→0 transition pops the held stack (per-thread depth)."""
+
+    _factory = staticmethod(threading.RLock)
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._depth = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not enabled():
+            return self._lock.acquire(blocking, timeout)
+        d = getattr(self._depth, "n", 0)
+        if d == 0:
+            lock_order_watcher.on_acquire(self.name)
+            ok = self._lock.acquire(blocking, timeout)
+            if not ok:
+                lock_order_watcher.on_acquired_failed(self.name)
+                return ok
+        else:
+            ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._depth.n = d + 1
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        # Depth (not enabled()) decides the pop — same toggle-safety
+        # contract as TrackedLock.release.
+        d = getattr(self._depth, "n", 0)
+        if d > 0:
+            self._depth.n = d - 1
+            if d == 1:
+                lock_order_watcher.on_release(self.name)
+
+    def locked(self) -> bool:
+        # threading.RLock grows .locked() only in 3.14; emulate it:
+        # owned-by-me counts as locked, else a non-blocking probe
+        # (which for an UNHELD rlock succeeds and is undone).
+        is_owned = getattr(self._lock, "_is_owned", None)
+        if is_owned is not None and is_owned():
+            return True
+        if self._lock.acquire(blocking=False):
+            self._lock.release()
+            return False
+        return True
+
+
+def tracked_lock(name: str) -> TrackedLock:
+    """A Lock whose acquires feed the lock-order watcher under
+    ``RAY_TPU_SANITIZE=1`` (plain Lock semantics otherwise)."""
+    return TrackedLock(name)
+
+
+def tracked_rlock(name: str) -> TrackedRLock:
+    return TrackedRLock(name)
+
+
 # ------------------------------------------------------------ stall watchdog
 class StallWatchdog:
     """Background detector for the observable shape of a host deadlock:
@@ -151,8 +365,9 @@ class StallWatchdog:
         while not self._stop.wait(self._period):
             try:
                 self._check()
-            except Exception:  # noqa: BLE001 — watcher must not die
-                pass
+            except Exception as exc:  # watcher must not die
+                print(f"[ray_tpu sanitizer] stall watchdog check "
+                      f"failed: {exc!r}", file=sys.stderr, flush=True)
 
     def _check(self):
         s = self._scheduler

@@ -50,9 +50,10 @@ def init(storage: Optional[Union[str, WorkflowStorage]] = None) -> None:
 
 
 def _default_root() -> str:
-    return os.environ.get(
-        "RAY_TPU_WORKFLOW_STORAGE",
-        os.path.join(os.path.expanduser("~"), ".ray_tpu", "workflows"))
+    from ray_tpu._private.config import GlobalConfig
+
+    return GlobalConfig.workflow_storage or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu", "workflows")
 
 
 def _ensure_storage(

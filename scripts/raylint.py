@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Run raylint (project-invariant static analysis) over the tree.
+
+    python scripts/raylint.py ray_tpu/
+
+See ray_tpu/devtools/raylint/cli.py for the full option set. The
+committed baseline lives next to this script in raylint_baseline.json
+and is gated to never grow.
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from ray_tpu.devtools.raylint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(root=_REPO_ROOT))

@@ -1,0 +1,590 @@
+"""raylint analyzer tests: one known violation per pass (and the
+matching clean counterpart), suppression-comment and baseline
+mechanics, and the whole-tree gate that makes every future PR
+analyzer-checked by construction."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.raylint import baseline as baseline_mod
+from ray_tpu.devtools.raylint.cli import main as raylint_main
+from ray_tpu.devtools.raylint.core import CHECKERS
+from ray_tpu.devtools.raylint.runner import AnalysisContext, run_analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_fixture(tmp_path, source, checks, name="fixture.py"):
+    mod = tmp_path / name
+    mod.write_text(textwrap.dedent(source))
+    result = run_analysis([str(mod)], str(tmp_path), checks=checks,
+                          ctx=AnalysisContext(root=str(tmp_path)))
+    return result.findings
+
+
+# ------------------------------------------------------------ lock-discipline
+LOCK_VIOLATION = """
+    import threading, time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1)
+"""
+
+
+def test_lock_discipline_fires(tmp_path):
+    findings = run_fixture(tmp_path, LOCK_VIOLATION,
+                           ["lock-discipline"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.check == "lock-discipline"
+    assert "time.sleep" in f.message and "_lock" in f.message
+    assert f.scope == "C.bad"
+
+
+def test_lock_discipline_suppressed(tmp_path):
+    src = LOCK_VIOLATION.replace(
+        "time.sleep(1)",
+        "time.sleep(1)  # raylint: disable=lock-discipline")
+    assert run_fixture(tmp_path, src, ["lock-discipline"]) == []
+
+
+def test_lock_discipline_suppression_line_above(tmp_path):
+    src = LOCK_VIOLATION.replace(
+        "                time.sleep(1)",
+        "                # raylint: disable=lock-discipline\n"
+        "                time.sleep(1)")
+    assert "disable" in src
+    assert run_fixture(tmp_path, src, ["lock-discipline"]) == []
+
+
+def test_lock_discipline_one_level_propagation(tmp_path):
+    src = """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _slow(self):
+                time.sleep(1)
+
+            def _refresh_locked(self):
+                time.sleep(1)
+
+            def bad(self):
+                with self._lock:
+                    self._slow()
+
+            def fine(self):
+                with self._lock:
+                    self._refresh_locked()  # *_locked convention: exempt
+    """
+    findings = run_fixture(tmp_path, src, ["lock-discipline"])
+    assert len(findings) == 1
+    assert findings[0].scope == "C.bad"
+    assert "_slow" in findings[0].message
+
+
+def test_lock_discipline_condition_wait_on_wrapped_lock_is_clean(tmp_path):
+    """Condition(self._lock).wait() while holding self._lock RELEASES
+    it — the sanctioned idiom (scheduler._dispatch_loop shape). An
+    Event.wait under the lock still fires."""
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._evt = threading.Event()
+
+            def fine(self):
+                with self._lock:
+                    self._cv.wait()
+
+            def bad(self):
+                with self._lock:
+                    self._evt.wait()
+    """
+    findings = run_fixture(tmp_path, src, ["lock-discipline"])
+    assert len(findings) == 1
+    assert findings[0].scope == "C.bad"
+    assert ".wait" in findings[0].detail or "wait" in findings[0].detail
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    findings = run_fixture(tmp_path, src, ["lock-discipline"])
+    cycles = [f for f in findings if "lock-order-cycle" in f.detail]
+    assert len(cycles) == 1
+    assert "C._a" in cycles[0].message and "C._b" in cycles[0].message
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """
+    assert run_fixture(tmp_path, src, ["lock-discipline"]) == []
+
+
+# ------------------------------------------------------------ counter-balance
+COUNTER_VIOLATION = """
+    class Pool:
+        def __init__(self):
+            self._in_flight = 0
+
+        def submit(self, fn):
+            self._in_flight += 1
+            fn()
+            self._in_flight -= 1
+"""
+
+
+def test_counter_balance_fires(tmp_path):
+    findings = run_fixture(tmp_path, COUNTER_VIOLATION,
+                           ["counter-balance"])
+    assert len(findings) == 1
+    assert findings[0].detail == "unbalanced:_in_flight"
+    assert findings[0].scope == "Pool.submit"
+
+
+def test_counter_balance_finally_is_clean(tmp_path):
+    src = """
+        class Pool:
+            def __init__(self):
+                self._in_flight = 0
+
+            def submit(self, fn):
+                self._in_flight += 1
+                try:
+                    fn()
+                finally:
+                    self._in_flight -= 1
+    """
+    assert run_fixture(tmp_path, src, ["counter-balance"]) == []
+
+
+def test_counter_balance_guarded_call_is_clean(tmp_path):
+    """A call that cannot propagate (broad swallow around it) is not a
+    leak path — the worker_pool._try_spawn shape."""
+    src = """
+        class Pool:
+            def __init__(self):
+                self._in_flight = 0
+
+            def submit(self, fn):
+                self._in_flight += 1
+                try:
+                    fn()
+                except Exception:
+                    pass
+                self._in_flight -= 1
+    """
+    assert run_fixture(tmp_path, src, ["counter-balance"]) == []
+
+
+def test_counter_balance_ignores_monotonic_stats(tmp_path):
+    src = """
+        class Stats:
+            def __init__(self):
+                self.hits = 0
+
+            def record(self, fn):
+                self.hits += 1
+                fn()
+    """
+    assert run_fixture(tmp_path, src, ["counter-balance"]) == []
+
+
+def test_counter_balance_suppressed(tmp_path):
+    src = COUNTER_VIOLATION.replace(
+        "self._in_flight += 1",
+        "self._in_flight += 1  # raylint: disable=counter-balance")
+    assert run_fixture(tmp_path, src, ["counter-balance"]) == []
+
+
+# ------------------------------------------------------- exception-discipline
+EXC_VIOLATION = """
+    class Daemon:
+        def _monitor_loop(self):
+            while True:
+                try:
+                    self.step()
+                except Exception:
+                    pass
+"""
+
+
+def test_exception_discipline_fires(tmp_path):
+    findings = run_fixture(tmp_path, EXC_VIOLATION,
+                           ["exception-discipline"])
+    assert len(findings) == 1
+    assert findings[0].detail == "swallow:Exception"
+    assert findings[0].scope == "Daemon._monitor_loop"
+
+
+def test_exception_discipline_logged_is_clean(tmp_path):
+    src = """
+        from ray_tpu._private.log import get_logger
+
+        log = get_logger(__name__)
+
+        class Daemon:
+            def _monitor_loop(self):
+                while True:
+                    try:
+                        self.step()
+                    except Exception as exc:
+                        log.debug("step failed: %r", exc)
+    """
+    assert run_fixture(tmp_path, src, ["exception-discipline"]) == []
+
+
+def test_exception_discipline_using_exc_is_clean(tmp_path):
+    """Routing the exception object somewhere (slot, typed wrapper)
+    counts as handling it."""
+    src = """
+        class Daemon:
+            def _serve_loop(self):
+                while True:
+                    try:
+                        self.step()
+                    except Exception as exc:
+                        self.last_error = exc
+    """
+    assert run_fixture(tmp_path, src, ["exception-discipline"]) == []
+
+
+def test_exception_discipline_outside_loops_is_clean(tmp_path):
+    src = """
+        class C:
+            def close(self):
+                try:
+                    self._sock.close()
+                except Exception:
+                    pass
+    """
+    assert run_fixture(tmp_path, src, ["exception-discipline"]) == []
+
+
+def test_exception_discipline_suppressed(tmp_path):
+    src = EXC_VIOLATION.replace(
+        "                except Exception:",
+        "                except Exception:"
+        "  # raylint: disable=exception-discipline")
+    assert run_fixture(tmp_path, src, ["exception-discipline"]) == []
+
+
+# ------------------------------------------------------------- flag-hygiene
+def _write_config(tmp_path, body):
+    cfg_dir = tmp_path / "ray_tpu" / "_private"
+    cfg_dir.mkdir(parents=True, exist_ok=True)
+    (cfg_dir / "config.py").write_text(textwrap.dedent(body))
+
+
+FLAG_CONFIG = """
+    def _D(name, type_, default, doc=""):
+        pass
+
+    _D("task_max_retries", int, 3, "Retries.")
+"""
+
+
+def test_flag_hygiene_env_read_fires(tmp_path):
+    _write_config(tmp_path, FLAG_CONFIG)
+    src = """
+        import os
+
+        def f():
+            return os.environ.get("RAY_TPU_TASK_MAX_RETRIES")
+    """
+    findings = run_fixture(tmp_path, src, ["flag-hygiene"])
+    env_reads = [f for f in findings if f.detail.startswith("env-read")]
+    assert len(env_reads) == 1
+    assert "RAY_TPU_TASK_MAX_RETRIES" in env_reads[0].detail
+
+
+def test_flag_hygiene_bootstrap_allowlist_is_clean(tmp_path):
+    _write_config(tmp_path, FLAG_CONFIG)
+    src = """
+        import os
+
+        def f():
+            return os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+    """
+    findings = run_fixture(tmp_path, src, ["flag-hygiene"])
+    assert [f for f in findings if f.detail.startswith("env-read")] == []
+
+
+def test_flag_hygiene_undeclared_attr_fires(tmp_path):
+    _write_config(tmp_path, FLAG_CONFIG)
+    src = """
+        from ray_tpu._private.config import GlobalConfig
+
+        def f():
+            return GlobalConfig.task_max_retries + GlobalConfig.not_a_flag
+    """
+    findings = run_fixture(tmp_path, src, ["flag-hygiene"])
+    undeclared = [f for f in findings if f.detail.startswith("undeclared")]
+    assert len(undeclared) == 1 and "not_a_flag" in undeclared[0].detail
+
+
+def test_flag_hygiene_undocumented_declare_fires(tmp_path):
+    _write_config(tmp_path,
+                  FLAG_CONFIG + '    _D("bare_flag", int, 0)\n')
+    findings = run_fixture(tmp_path, "x = 1\n", ["flag-hygiene"])
+    undoc = [f for f in findings if f.detail == "undocumented:bare_flag"]
+    assert len(undoc) == 1
+
+
+def test_flag_hygiene_suppressed(tmp_path):
+    _write_config(tmp_path, FLAG_CONFIG)
+    src = """
+        import os
+
+        def f():  # bootstrap shim kept deliberately
+            return os.environ.get("RAY_TPU_TASK_MAX_RETRIES")  # raylint: disable=flag-hygiene
+    """
+    findings = run_fixture(tmp_path, src, ["flag-hygiene"])
+    assert [f for f in findings if f.detail.startswith("env-read")] == []
+
+
+def test_flag_hygiene_readme_table(tmp_path):
+    _write_config(tmp_path, FLAG_CONFIG)
+    (tmp_path / "README.md").write_text(
+        "| `RAY_TPU_TASK_MAX_RETRIES` | retries |\n")
+    mod = tmp_path / "fixture.py"
+    mod.write_text("x = 1\n")
+    result = run_analysis([str(mod)], str(tmp_path),
+                          checks=["flag-hygiene"],
+                          ctx=AnalysisContext(root=str(tmp_path)))
+    missing = [f for f in result.findings
+               if f.detail.startswith("not-in-readme")]
+    # every bootstrap flag except any mentioned is reported missing;
+    # the declared flag IS documented so it never appears
+    assert all("TASK_MAX_RETRIES" not in f.detail for f in missing)
+    assert any("RAY_TPU_SANITIZE" in f.detail for f in missing)
+
+
+# ------------------------------------------------------------ thread-hygiene
+THREAD_VIOLATION = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+"""
+
+
+def test_thread_hygiene_fires(tmp_path):
+    findings = run_fixture(tmp_path, THREAD_VIOLATION,
+                           ["thread-hygiene"])
+    assert len(findings) == 1
+    assert findings[0].detail == "unjoined:_t"
+
+
+def test_thread_hygiene_daemon_is_clean(tmp_path):
+    src = THREAD_VIOLATION.replace("target=self._run",
+                                   "target=self._run, daemon=True")
+    assert run_fixture(tmp_path, src, ["thread-hygiene"]) == []
+
+
+def test_thread_hygiene_joined_is_clean(tmp_path):
+    src = THREAD_VIOLATION + """
+        def stop(self):
+            self._t.join()
+    """
+    assert run_fixture(tmp_path, src, ["thread-hygiene"]) == []
+
+
+def test_thread_hygiene_suppressed(tmp_path):
+    src = THREAD_VIOLATION.replace(
+        "self._t = threading.Thread(target=self._run)",
+        "self._t = threading.Thread(target=self._run)"
+        "  # raylint: disable=thread-hygiene")
+    assert run_fixture(tmp_path, src, ["thread-hygiene"]) == []
+
+
+# --------------------------------------------------------- finding identity
+def test_finding_ids_are_line_independent(tmp_path):
+    """Prepending unrelated lines must not change a finding's id — the
+    property the committed baseline depends on."""
+    f1 = run_fixture(tmp_path, LOCK_VIOLATION, ["lock-discipline"],
+                     name="a.py")
+    f2 = run_fixture(tmp_path, "# header comment\n\nX = 1\n"
+                     + textwrap.dedent(LOCK_VIOLATION),
+                     ["lock-discipline"], name="a.py")
+    assert f1[0].fid == f2[0].fid
+    assert f1[0].line != f2[0].line
+
+
+def test_duplicate_findings_get_numbered_ids(tmp_path):
+    src = LOCK_VIOLATION + """
+            def also_bad(self):
+                with self._lock:
+                    time.sleep(1)
+                    time.sleep(2)
+    """
+    findings = run_fixture(tmp_path, src, ["lock-discipline"])
+    ids = [f.fid for f in findings]
+    assert len(ids) == 3 and len(set(ids)) == 3
+    assert any(i.endswith("#2") for i in ids)
+
+
+# --------------------------------------------------------- baseline mechanics
+def test_baseline_compare():
+    base = {"version": 1, "budget": 2, "findings": ["a", "b"]}
+    new, stale, over = baseline_mod.compare(["a", "c"], base)
+    assert new == ["c"] and stale == ["b"] and not over
+    new, stale, over = baseline_mod.compare(["a", "b", "c"], base)
+    assert over  # 3 findings > budget 2
+
+
+def test_baseline_never_grows_via_cli(tmp_path):
+    """End-to-end CLI gate: clean tree passes; a new finding fails even
+    if someone hand-adds it to the baseline without shrinking elsewhere
+    (budget ratchet); --update-baseline resets legitimately."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.json"
+
+    assert raylint_main(
+        ["pkg", "--checks", "lock-discipline", "--baseline", str(bl)],
+        root=str(tmp_path)) == 0
+
+    (pkg / "bad.py").write_text(textwrap.dedent(LOCK_VIOLATION))
+    # new finding, empty baseline -> gate fails
+    assert raylint_main(
+        ["pkg", "--checks", "lock-discipline", "--baseline", str(bl)],
+        root=str(tmp_path)) == 1
+
+    # hand-add the finding id but keep budget at 0: still fails (grew)
+    out = run_analysis([str(pkg)], str(tmp_path),
+                       checks=["lock-discipline"],
+                       ctx=AnalysisContext(root=str(tmp_path)))
+    bl.write_text(json.dumps({
+        "version": 1, "budget": 0,
+        "findings": [f.fid for f in out.findings]}))
+    assert raylint_main(
+        ["pkg", "--checks", "lock-discipline", "--baseline", str(bl)],
+        root=str(tmp_path)) == 1
+
+    # legitimate baseline update: passes, and fixing the finding then
+    # fails the gate via staleness until the entry is removed
+    assert raylint_main(
+        ["pkg", "--checks", "lock-discipline", "--baseline", str(bl),
+         "--update-baseline"], root=str(tmp_path)) == 0
+    assert raylint_main(
+        ["pkg", "--checks", "lock-discipline", "--baseline", str(bl)],
+        root=str(tmp_path)) == 0
+    (pkg / "bad.py").write_text("x = 2\n")
+    assert raylint_main(
+        ["pkg", "--checks", "lock-discipline", "--baseline", str(bl)],
+        root=str(tmp_path)) == 1  # stale entry must be pruned
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    result = run_analysis([str(bad)], str(tmp_path),
+                          checks=["lock-discipline"],
+                          ctx=AnalysisContext(root=str(tmp_path)))
+    assert [f.check for f in result.findings] == ["parse-error"]
+
+
+# ------------------------------------------------------------ whole-tree gate
+def test_whole_tree_zero_non_baselined_findings():
+    """THE gate: the analyzer over the real tree must come back clean
+    against the committed baseline — no new findings, no stale
+    entries, within budget — and fast enough for tier-1."""
+    result = run_analysis(["ray_tpu"], REPO_ROOT,
+                          ctx=AnalysisContext(root=REPO_ROOT))
+    assert result.parse_errors == []
+    baseline = baseline_mod.load(
+        os.path.join(REPO_ROOT, "scripts", "raylint_baseline.json"))
+    ids = [f.fid for f in result.findings]
+    new, stale, over = baseline_mod.compare(ids, baseline)
+    assert new == [], f"non-baselined findings:\n" + "\n".join(
+        f.render() for f in result.findings if f.fid in set(new))
+    assert stale == [], f"stale baseline entries (remove them): {stale}"
+    assert not over, (f"{len(ids)} findings exceed baseline budget "
+                      f"{baseline['budget']} — the baseline never grows")
+    assert baseline["budget"] == len(baseline["findings"]), \
+        "budget must equal the baseline size (the ratchet invariant)"
+    assert result.elapsed_s < 30.0, \
+        f"analysis took {result.elapsed_s:.1f}s (budget 30s)"
+
+
+def test_all_five_passes_registered():
+    assert {"lock-discipline", "counter-balance",
+            "exception-discipline", "flag-hygiene",
+            "thread-hygiene"} <= set(CHECKERS)
+
+
+def test_cli_checks_subset_respects_other_checks_baseline(tmp_path):
+    """--checks must not report other passes' baselined entries as
+    stale (and --update-baseline under --checks must carry them)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mixed.py").write_text(textwrap.dedent(LOCK_VIOLATION) +
+                                  textwrap.dedent(COUNTER_VIOLATION))
+    bl = tmp_path / "baseline.json"
+    # baseline everything
+    assert raylint_main(["pkg", "--baseline", str(bl),
+                         "--update-baseline"], root=str(tmp_path)) == 0
+    # full gate green; subset gate must be green too (counter-balance
+    # entries are not 'stale' just because that pass didn't run)
+    assert raylint_main(["pkg", "--baseline", str(bl)],
+                        root=str(tmp_path)) == 0
+    assert raylint_main(["pkg", "--checks", "lock-discipline",
+                         "--baseline", str(bl)], root=str(tmp_path)) == 0
+    # subset update keeps the other pass's entries
+    assert raylint_main(["pkg", "--checks", "lock-discipline",
+                         "--baseline", str(bl), "--update-baseline"],
+                        root=str(tmp_path)) == 0
+    kept = json.loads(bl.read_text())["findings"]
+    assert any(fid.startswith("counter-balance:") for fid in kept)
+    assert raylint_main(["pkg", "--baseline", str(bl)],
+                        root=str(tmp_path)) == 0
