@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: test analyze bench bench-control-plane bench-llm \
-	bench-llm-prefix bench-gate bench-chaos chaos-gate
+	bench-llm-prefix bench-gate bench-chaos bench-ownership chaos-gate
 
 test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -42,6 +42,17 @@ bench-llm-prefix:
 # failures). One JSON line.
 bench-chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite chaos_slo
+
+# Ownership-directory flatness probe: a real head + 2 node daemons run
+# a steady-state fan-out, 32 simulated members join, and the driver's
+# owner directory ingests synthetic direct completion reports for 10k
+# then 100k objects — head object-plane RPCs and FT-log appends must
+# stay flat in object count (O(membership)); owner_locate answers are
+# served over the real p2p plane. One JSON line; the flatness headline
+# (ownership.head_rpcs_per_1k_objects) is REQUIRED by check_bench with
+# an ABSOLUTE <= 1.0 gate.
+bench-ownership:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite ownership
 
 # Deterministic chaos slice inside tier-1 time: the seeded fault-
 # injection / NodeKiller / shedding matrix cells (pytest -m chaos,

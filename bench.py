@@ -1141,6 +1141,217 @@ def bench_llm_prefix(repeats=3):
     }
 
 
+def bench_ownership(n_small=10_000, n_big=100_000, n_members=32,
+                    fanout=2_000):
+    """Config #13: the ownership-based object directory (PR 10). The
+    head must stay O(membership), NOT O(objects), in the steady-state
+    object plane. Two parts, one real cluster:
+
+    1. REAL fan-out micro-proof: head + 2 node daemons + zero-CPU
+       driver run a ``fanout``-task fan-out over the wire; the head's
+       own ``head_stats`` counters (per-kind RPCs + FT-log appends)
+       are measured across the steady-state window — object-plane RPC
+       and log-append deltas must be ZERO while completions flow
+       node→driver direct and result pulls ride the owner's table.
+    2. SIMULATED many-node / 100k-object scale: ``n_members`` extra
+       members register (the O(membership) control traffic), then the
+       driver's owner directory ingests synthetic DIRECT task_done
+       reports — byte-identical to what node daemons push — for
+       ``n_small`` and then ``n_big`` objects, serving owner_locate
+       answers over the real p2p plane for a sample of each. The
+       marginal head cost per 1k objects between the two scales is the
+       flatness headline (``head_rpcs_per_1k_objects``,
+       ``log_appends_per_1k_objects`` — both ~0; membership writes
+       land ~n_members appends by contrast).
+    """
+    import os
+    import pickle
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    result = {"suite": "ownership"}
+    procs = []
+    state_path = "/tmp/ray_tpu_bench_own_state.log"
+    for stale in (state_path, state_path + ".lock"):
+        try:  # a PRIOR run's replayed members would poison node_list
+            os.remove(stale)
+        except OSError:
+            pass
+    try:
+        import ray_tpu
+        from ray_tpu._private import transport
+
+        head = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", "0", "--state", state_path],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(head)
+        line = head.stdout.readline()
+        assert "listening" in line, f"head failed to start: {line!r}"
+        address = line.strip().rsplit(" ", 1)[-1]
+        for _ in range(2):
+            node = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.node_daemon",
+                 "--address", address, "--num-cpus", "2",
+                 "--worker-mode", "thread"],
+                stdout=subprocess.PIPE, text=True, env=env)
+            procs.append(node)
+            assert "joined" in node.stdout.readline()
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+        w = ray_tpu._private.worker.global_worker()
+        hc = w.head_client
+        router = w.remote_router
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            nodes = hc.node_list()
+            if len(nodes) == 2 and all(n.get("peer_addr") for n in nodes):
+                break
+            time.sleep(0.1)
+
+        @ray_tpu.remote
+        def noop(x):
+            return x
+
+        assert ray_tpu.get(noop.remote(41), timeout=60) == 41  # warm
+
+        # ---- part 1: real steady-state fan-out, head counters flat.
+        before = hc.head_stats()
+        t0 = time.perf_counter()
+        refs = [noop.remote(i) for i in range(fanout)]
+        out = ray_tpu.get(refs, timeout=600)
+        wall = time.perf_counter() - t0
+        assert out == list(range(fanout))
+        after = hc.head_stats()
+        result["cluster_fanout"] = {
+            "tasks": fanout,
+            "tasks_per_sec_observed": fanout / wall,
+            "head_object_plane_rpcs_delta":
+                after["object_plane_rpcs"] - before["object_plane_rpcs"],
+            "head_log_appends_delta":
+                after["log_appends"] - before["log_appends"],
+            # rpc_counts increments at dispatch ENTRY, so the "before"
+            # reply already counts itself — only the "after" head_stats
+            # call is extra in the delta.
+            "head_rpc_total_delta":
+                after["rpc_total"] - before["rpc_total"] - 1,
+            "direct_done_reports": router.direct_done_reports,
+            "relayed_done_reports": router.relayed_done_reports,
+            "owner_table_pulls": router.owner_table_pulls,
+            "inline_results": router.inline_results,
+        }
+
+        # ---- part 2: membership registers (O(membership) writes)...
+        host, _, port = address.rpartition(":")
+        before_members = hc.head_stats()
+        member_conns = []
+        for i in range(n_members):
+            conn = transport.connect(host, int(port), hc.token,
+                                     timeout=5.0, site="head")
+            conn.send(("hello", f"simnode-{i}", "request"))
+            conn.recv()
+            conn.send(("node_register", f"simnode-{i}", {"CPU": 4.0}))
+            conn.recv()
+            member_conns.append(conn)
+        after_members = hc.head_stats()
+        result["membership"] = {
+            "members_joined": n_members,
+            "head_log_appends_delta":
+                after_members["log_appends"]
+                - before_members["log_appends"],
+            "nodes_alive": after_members["nodes_alive"],
+        }
+
+        # ---- ...then the owner directory ingests synthetic direct
+        # task_done reports (the node daemons' exact wire payloads) at
+        # two object scales, serving real p2p locates for a sample.
+        node_client = next(n for n in hc.node_list()
+                           if n.get("peer_addr"))["client_id"]
+        from ray_tpu._private.ids import ObjectID, TaskID
+
+        def _ingest(n_objects):
+            t0 = time.perf_counter()
+            sample = []
+            for i in range(n_objects):
+                tid = TaskID.from_random()
+                ob = ObjectID.for_task_return(tid, 0).binary()
+                done = pickle.dumps({
+                    "task_id": tid.binary(),
+                    "oid_bins": [ob],
+                    "node_client": node_client,
+                    "sizes": {ob: 1024},
+                    "errs": {}, "inline": {},
+                }, protocol=5)
+                router._on_task_done(("task_done", done))
+                if i % max(1, n_objects // 64) == 0:
+                    sample.append(ob)
+            ingest_s = time.perf_counter() - t0
+            # Serve owner_locate for the sample over the REAL p2p plane
+            # (a peer dialing this driver's object server).
+            own_addr = tuple(hc._object_server.address)
+            served = 0
+            for ob in sample:
+                reply = hc._peers.call(own_addr,
+                                       ("owner_locate", ob, None))
+                assert reply["status"] == "ready", reply
+                served += 1
+            return ingest_s, served
+
+        before_small = hc.head_stats()
+        ingest_small_s, served_small = _ingest(n_small)
+        after_small = hc.head_stats()
+        ingest_big_s, served_big = _ingest(n_big)
+        after_big = hc.head_stats()
+
+        def _delta(a, b, key):
+            return b[key] - a[key]
+
+        obj_rpcs_small = _delta(before_small, after_small,
+                                "object_plane_rpcs")
+        obj_rpcs_big = _delta(after_small, after_big,
+                              "object_plane_rpcs")
+        appends_small = _delta(before_small, after_small, "log_appends")
+        appends_big = _delta(after_small, after_big, "log_appends")
+        marginal_objects_k = (n_big - n_small) / 1000.0
+        result["simulated_scale"] = {
+            "objects_small": n_small, "objects_big": n_big,
+            "owner_ingest_objects_per_sec":
+                n_big / max(ingest_big_s, 1e-9),
+            "owner_locates_served": served_small + served_big,
+            "head_object_plane_rpcs_at_small": obj_rpcs_small,
+            "head_object_plane_rpcs_at_big": obj_rpcs_big,
+            "head_log_appends_at_small": appends_small,
+            "head_log_appends_at_big": appends_big,
+        }
+        # Flatness headlines: marginal head cost per 1k EXTRA objects
+        # between the two scales (0 when the head saw no object RPC).
+        result["head_rpcs_per_1k_objects"] = max(
+            0.0, (obj_rpcs_big - obj_rpcs_small)) / marginal_objects_k
+        result["log_appends_per_1k_objects"] = max(
+            0.0, (appends_big - appends_small)) / marginal_objects_k
+        result["locations_tracked"] = len(router._oid_owner)
+        for conn in member_conns:
+            conn.close()
+    except Exception as e:  # noqa: BLE001 — cluster spin-up optional
+        result["skipped"] = repr(e)
+    finally:
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        for p in reversed(procs):
+            p.kill()
+            p.wait(timeout=5)
+    return result
+
+
 def bench_chaos_slo(n_high=180, n_low=40, max_new=4):
     """Config #12: the chaos × load SLO probe (PR 8). A many-hundred-
     concurrent-stream load generator against a 2-replica LLM serving
@@ -1544,7 +1755,7 @@ def main():
     parser.add_argument("--suite", choices=[
         "chain", "fanout", "actor", "data", "rl", "model", "sharded",
         "control_plane", "workflow", "streaming", "llm_serving",
-        "llm_prefix", "chaos_slo"],
+        "llm_prefix", "chaos_slo", "ownership"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -1570,6 +1781,7 @@ def main():
         "llm_serving": bench_llm_serving,
         "llm_prefix": bench_llm_prefix,
         "chaos_slo": bench_chaos_slo,
+        "ownership": bench_ownership,
     }
 
     if args.suite:

@@ -216,6 +216,13 @@ _D("native_cache", str, "",
 _D("coordinator_address", str, "",
    "Multi-process device-plane coordinator address for "
    "parallel.distributed.initialize ('' = single-process mesh).")
+_D("ownership_directory", bool, True,
+   "Ownership-based object directory: node daemons skip the per-object "
+   "steady-state object_announce to the head (locations flow to the "
+   "owning driver in the direct task_done/item_done reports; peers "
+   "resolve owner-direct over the p2p plane), and an exiting driver "
+   "lease-transfers its table to the head. Off = every completion "
+   "announces to the head (the pre-ownership centralized directory).")
 _D("head_log_compact_records", int, 50000,
    "Compact the head's append-only state log once it holds this many "
    "records (snapshot + truncate; 0 disables compaction).")

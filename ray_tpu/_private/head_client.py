@@ -52,7 +52,8 @@ _REQ_BATCH_MAX = 256  # request-coalescer flush-at-N bound
 # object relays) are capped per batch so a batchrep frame stays far
 # below MAX_FRAME: 24 x 4 MiB chunks ≈ 96 MiB worst case.
 _REQ_BATCH_HEAVY_MAX = 24
-_HEAVY_KINDS = frozenset({"object_chunk", "object_pull"})
+_HEAVY_KINDS = frozenset({"object_chunk", "object_chunk_from",
+                          "object_pull"})
 # Aggregate request-byte budget per batch (estimated from top-level
 # bytes fields): big inlined payloads flush in small batches instead of
 # being packed into a near-cap frame only to be split and re-packed.
@@ -456,6 +457,31 @@ class HeadClient:
         for slot in slots:
             self._request_result(slot)
 
+    def object_transfer_many(self, entries) -> None:
+        """Lease handoff: delegate this owner's location table to the
+        head's fallback directory. ``entries`` = [(oid_bin,
+        holder_client_id), ...] — the HOLDER of the bytes is recorded,
+        so entries live and GC with the holding node, not with the
+        exiting owner. Shipped in bulk batches (one frame and ONE head
+        log record per batch), so a long-lived owner's handoff costs
+        O(batches), not O(objects-ever-completed)."""
+        entries = list(entries)
+        slots = [self._request_async(
+            ("object_transfer_batch", tuple(entries[i:i + 4096])))
+            for i in range(0, len(entries), 4096)]
+        for slot in slots:
+            try:
+                self._request_result(slot)
+            except Exception as exc:  # noqa: BLE001 — head gone
+                log.warning("lease-handoff batch lost (head "
+                            "unreachable); borrowers of its entries "
+                            "will fail typed: %r", exc)
+
+    def head_stats(self) -> dict:
+        """The head's steady-state observability surface: per-kind RPC
+        counts, FT-log appends, directory/membership sizes."""
+        return dict(self._request(("head_stats",)))
+
     def object_pull(self, oid_bin: bytes) -> Optional[bytes]:
         """Pull a remote object's serialized bytes: direct peer-to-peer
         from the owner's object server when the head knows its address
@@ -469,12 +495,29 @@ class HeadClient:
                 return raw
         return self._object_pull_relayed(oid_bin)
 
-    def _object_pull_relayed(self, oid_bin: bytes) -> Optional[bytes]:
+    def object_pull_from(self, holder: str, oid_bin: bytes
+                         ) -> Optional[bytes]:
+        """Head-relayed chunked pull from a NAMED holder: under the
+        ownership directory the OWNER resolved the location — the head
+        only relays the bytes for a puller that cannot reach the holder
+        peer-to-peer (NAT, reset lanes). Never consults the head's
+        directory."""
+        return self._object_pull_relayed(oid_bin, holder=holder)
+
+    def _object_pull_relayed(self, oid_bin: bytes,
+                             holder: Optional[str] = None
+                             ) -> Optional[bytes]:
         """Head-relayed chunked pull with a request window: up to
         _PULL_WINDOW chunk RPCs stay in flight (they coalesce into batch
         frames and the head relays them concurrently), so transfer
-        overlaps round-trip latency instead of serializing behind it."""
-        size = self._request(("object_meta", oid_bin))
+        overlaps round-trip latency instead of serializing behind it.
+        With ``holder`` the relay targets that client directly
+        (ownership: location already resolved); without it the head's
+        fallback directory resolves the owner."""
+        if holder is None:
+            size = self._request(("object_meta", oid_bin))
+        else:
+            size = self._request(("object_meta_from", holder, oid_bin))
         if size is None:
             return None
         offsets = list(range(0, size, _PULL_CHUNK))
@@ -487,7 +530,10 @@ class HeadClient:
                 offset = offsets[issued]
                 length = min(_PULL_CHUNK, size - offset)
                 slots.append(self._request_async(
-                    ("object_chunk", oid_bin, offset, length)))
+                    ("object_chunk", oid_bin, offset, length)
+                    if holder is None else
+                    ("object_chunk_from", holder, oid_bin, offset,
+                     length)))
                 issued += 1
             chunk = self._request_result(slots[len(parts)])
             if not chunk:

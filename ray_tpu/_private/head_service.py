@@ -209,6 +209,10 @@ class _StateLog:
         self._f = open(path, "ab")
         self._lock = threading.Lock()
         self.appended = 0  # records since open/compaction
+        # Lifetime append count (never reset by compaction): the
+        # observable the ownership flatness gate watches — steady-state
+        # object traffic must not grow this with object count.
+        self.total_appended = 0
 
     def _acquire_fence(self, timeout: Optional[float]) -> None:
         """Exclusive writer lock; ``timeout=None`` waits for the prior
@@ -251,6 +255,7 @@ class _StateLog:
             self._f.write(self._LEN.pack(len(data)) + data)
             self._f.flush()
             self.appended += 1
+            self.total_appended += 1
 
     def rewrite(self, snapshot: tuple):
         """Replace the log with a single snapshot record (compaction).
@@ -346,6 +351,15 @@ class HeadService:
         self._rpc_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="head-rpc")
         self.batches_received = 0
+        # Per-kind RPC counters (the ownership flatness observable:
+        # steady-state object-plane kinds must stay O(membership), not
+        # O(objects) — served over ``head_stats`` / ``/api/head``).
+        self.rpc_counts: Dict[str, int] = {}
+        # Live count of ``obj|`` directory subscriptions across clients
+        # (kept in step with every c.subs mutation under self._lock):
+        # the common zero-subscriber case makes announce-path object
+        # events O(1) instead of an O(clients) scan.
+        self._obj_sub_count = 0
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
@@ -393,6 +407,10 @@ class HeadService:
                 self._clients.setdefault(cid, _Client(cid))
             elif op == "actor_deregister":
                 self._actors.pop((rec[1], rec[2]), None)
+            elif op == "object_transfer_batch":
+                for ob, holder in rec[1]:
+                    self._objects[bytes(ob)] = holder
+                    self._clients.setdefault(holder, _Client(holder))
             elif op == "object_announce":
                 self._objects[rec[1]] = rec[2]
                 self._clients.setdefault(rec[2], _Client(rec[2]))
@@ -543,6 +561,7 @@ class HeadService:
                 c = self._clients.setdefault(client_id, _Client(client_id))
                 c.last_seen = time.monotonic()
                 c.alive = True
+                self.rpc_counts[kind] = self.rpc_counts.get(kind, 0) + 1
             if kind == "heartbeat":
                 if len(msg) > 1 and isinstance(msg[1], dict):
                     with self._lock:
@@ -552,18 +571,28 @@ class HeadService:
                         # persist them; the owner re-asserts).
                         subs = msg[1].get("_subs")
                         if subs is not None:
-                            c.subs = set(subs)
+                            new = set(subs)
+                            self._obj_sub_count += \
+                                self._count_obj_subs(new) - \
+                                self._count_obj_subs(c.subs)
+                            c.subs = new
                         addr = msg[1].get("_peer_addr")
                         if addr is not None:
                             c.peer_addr = (str(addr[0]), int(addr[1]))
                 return ("ok", None)
             if kind == "subscribe":
                 with self._lock:
-                    c.subs.add(msg[1])
+                    if msg[1] not in c.subs:
+                        c.subs.add(msg[1])
+                        if msg[1].startswith("obj|"):
+                            self._obj_sub_count += 1
                 return ("ok", None)
             if kind == "unsubscribe":
                 with self._lock:
-                    c.subs.discard(msg[1])
+                    if msg[1] in c.subs:
+                        c.subs.discard(msg[1])
+                        if msg[1].startswith("obj|"):
+                            self._obj_sub_count -= 1
                 return ("ok", None)
             if kind == "publish":
                 _, topic, payload = msg
@@ -662,7 +691,60 @@ class HeadService:
                 with self._lock:
                     self._objects[msg[1]] = client_id
                 self._persist("object_announce", msg[1], client_id)
+                self._publish_object_event(msg[1])
                 return ("ok", None)
+            if kind == "object_transfer_batch":
+                # Lease handoff (ownership model): an exiting OWNER
+                # delegates its location table — each entry names the
+                # HOLDER of the bytes, not the announcing client, so the
+                # entry lives and GCs with the holding node. Bulk: one
+                # frame and ONE log record per batch, not per entry (the
+                # head's handoff cost is O(batches)).
+                _, entries = msg
+                accepted = []
+                with self._lock:
+                    for ob, holder in entries:
+                        if holder in self._clients:
+                            self._objects[ob] = holder
+                            accepted.append((ob, holder))
+                if accepted:
+                    self._persist("object_transfer_batch", accepted)
+                    for ob, _holder in accepted:
+                        # O(1) no-subscriber gate inside — a waiter of a
+                        # transferred entry wakes event-driven.
+                        self._publish_object_event(ob)
+                return ("ok", len(accepted))
+            if kind == "head_stats":
+                # Steady-state observability: per-kind RPC counts and
+                # FT-log appends — the production surface behind the
+                # "head stays O(membership)" flatness claim.
+                with self._lock:
+                    counts = dict(self.rpc_counts)
+                    num_objects = len(self._objects)
+                    clients_alive = sum(
+                        1 for cl in self._clients.values() if cl.alive)
+                    nodes_alive = sum(
+                        1 for cl in self._clients.values()
+                        if cl.is_node and cl.alive)
+                state_log = self._log
+                return ("ok", {
+                    "rpc_counts": counts,
+                    "rpc_total": sum(counts.values()),
+                    "object_plane_rpcs": sum(
+                        counts.get(k, 0) for k in (
+                            "object_announce", "object_transfer_batch",
+                            "object_locate", "object_pull",
+                            "object_meta", "object_chunk",
+                            "object_meta_from", "object_chunk_from")),
+                    "log_appends": (state_log.total_appended
+                                    if state_log is not None else 0),
+                    "log_records_live": (state_log.appended
+                                         if state_log is not None else 0),
+                    "batches_received": self.batches_received,
+                    "num_objects": num_objects,
+                    "clients_alive": clients_alive,
+                    "nodes_alive": nodes_alive,
+                })
             # Object reads are bounded-latency relays: a wedged owner must
             # not hang the pulling client's request thread forever (actor
             # calls stay unbounded — long-running methods are legitimate).
@@ -693,6 +775,23 @@ class HeadService:
                     return ("ok", None)
                 return self._relay(owner, ("object_meta", oid_bin),
                                    timeout=60.0)
+            if kind == "object_meta_from":
+                # Relay-from-named-holder family (ownership model): the
+                # OWNER already resolved the location — the head only
+                # moves the bytes for peers that cannot dial the holder
+                # directly (NAT, poisoned lanes). No directory lookup.
+                _, holder, oid_bin = msg
+                if not self._is_alive(holder):
+                    return ("ok", None)
+                return self._relay(holder, ("object_meta", oid_bin),
+                                   timeout=60.0)
+            if kind == "object_chunk_from":
+                _, holder, oid_bin, offset, length = msg
+                if not self._is_alive(holder):
+                    return ("ok", None)
+                return self._relay(
+                    holder, ("object_chunk", oid_bin, offset, length),
+                    timeout=60.0)
             if kind == "object_chunk":
                 _, oid_bin, offset, length = msg
                 owner = self._object_owner(oid_bin)
@@ -730,15 +829,18 @@ class HeadService:
                 return self._relay(target_client, ("task_push", payload),
                                    timeout=60.0)
             if kind == "task_done":
-                # Node -> head -> submitting driver. Record result object
-                # locations first so the driver's pull finds an owner even
-                # if it races the relay.
+                # Node -> head -> submitting driver (the RELAY fallback
+                # — steady-state completions go node->driver direct and
+                # never touch this). Record result object locations
+                # first so the driver's pull finds an owner even if it
+                # races the relay.
                 _, driver_id, oid_bins, payload = msg
                 with self._lock:
                     for ob in oid_bins:
                         self._objects[ob] = client_id
                 for ob in oid_bins:
                     self._persist("object_announce", ob, client_id)
+                    self._publish_object_event(ob)
                 return self._relay(driver_id, ("task_done", payload),
                                    timeout=30.0)
             if kind == "demand_report":
@@ -767,6 +869,24 @@ class HeadService:
                 f"unknown request {kind!r}")))
         except Exception as exc:  # noqa: BLE001 — dispatch boundary
             return ("err", exc_to_wire(exc))
+
+    @staticmethod
+    def _count_obj_subs(subs) -> int:
+        return sum(1 for t in subs if t.startswith("obj|"))
+
+    def _publish_object_event(self, oid_bin: bytes) -> None:
+        """Wake directory subscribers of one object (``obj|<hex>``
+        topic): the event-driven edge of the fallback directory — a
+        client waiting out a foreign ref re-pulls on announce/transfer
+        instead of polling the head. No subscriber anywhere, no work
+        (one counter read — the announce hot path of the rollback mode
+        must not pay an O(clients) scan per object)."""
+        if self._obj_sub_count <= 0:
+            return
+        try:
+            self._publish("obj|" + bytes(oid_bin).hex(), True)
+        except Exception:  # noqa: BLE001 — wakeups are best-effort;
+            pass           # waiters re-check at their deadline anyway
 
     def _publish(self, topic: str, payload) -> int:
         """Fan a message out to every live subscriber of `topic`
@@ -843,6 +963,7 @@ class HeadService:
                             if not c.alive
                             and now - c.last_seen > 6 * timeout_s]:
                     c = self._clients.pop(cid)
+                    self._obj_sub_count -= self._count_obj_subs(c.subs)
                     if c.events is not None:
                         c.events.fail_all("client pruned")
                         try:

@@ -204,6 +204,11 @@ class NodeDaemon:
         self._fn_cache_cap = 64 << 20
         self._fn_lock = threading.Lock()
         self.fn_bytes_received = 0  # bench counter: cache effectiveness
+        # Ownership-directory counters: completion batches delivered
+        # owner-direct (zero head object traffic) vs. locations the
+        # relay fallback had to announce to the head.
+        self.direct_report_batches = 0
+        self.announce_fallback_oids = 0
 
     # -------------------------------------------------------- function cache
     def _register_fn(self, fn_bytes: bytes) -> bytes:
@@ -382,73 +387,34 @@ class NodeDaemon:
         return "accepted"
 
     def _ensure_object(self, oid_bin: bytes,
-                       deadline: float | None = None):
-        """Materialize one pull-ref's bytes into the local store,
-        WAITING OUT a pending producer: tasks ship with pull-refs before
-        their upstream finished (async dependency shipping), so "no live
-        owner yet" means not-produced-yet, not lost — poll the directory
-        with backoff until the owner announces or the dep-wait bound
-        expires. A producer that FAILED surfaces as the relayed pull
-        raising its task error; materialize it locally so execution
-        reports the real error instead of a timeout."""
-        from ray_tpu._private.serialization import SerializedObject
-        from ray_tpu.exceptions import GetTimeoutError, RayTaskError
-
+                       deadline: float | None = None,
+                       owner: tuple | None = None):
+        """Materialize one pull-ref's bytes into the local store through
+        its OWNER (the driver that pushed the task): ``owner_locate``
+        over the p2p plane names the node holding the bytes — or
+        subscribes this node when the producer is still in flight (async
+        dependency shipping), so the owner's ``owner_notify`` wakes the
+        wait the moment the completion report lands. The head's
+        directory is strictly the fallback (owner unreachable /
+        lease-transferred entries); a dead owner with no fallback copy
+        materializes a typed ``OwnerDiedError``. A producer that FAILED
+        arrives as a pickled error in the locate answer; it
+        materializes locally so execution reports the real error
+        instead of a timeout."""
         oid = ObjectID(bytes(oid_bin))
         store = self.worker.store
         if store.is_ready(oid):
             return
         if deadline is None:
             deadline = time.monotonic() + GlobalConfig.dep_wait_s
-        # Event-driven local edge: when the producer runs ON THIS NODE
-        # (locality placement colocates chains), the store's ready
-        # callback wakes the wait the moment the value lands — the
-        # directory backoff below only paces CROSS-node waits.
-        local_ready = threading.Event()
-        store.on_ready(oid, local_ready.set)
-        backoff = 0.02
-        while True:
-            if store.is_ready(oid):
-                return  # local producer / concurrent pull landed it
-            if store.has_local_producer(oid):
-                # The producer runs ON THIS NODE (locality colocation):
-                # the on_ready event is the completion signal — don't
-                # put the head back in the steady-state path with
-                # directory polls that can never resolve sooner.
-                if time.monotonic() > deadline:
-                    raise GetTimeoutError(
-                        f"pull-ref {oid.hex()[:16]}… was not produced "
-                        f"within the dependency wait bound "
-                        f"({GlobalConfig.dep_wait_s:.0f}s, "
-                        f"RAY_TPU_DEP_WAIT_S)")
-                local_ready.wait(backoff)
-                backoff = min(backoff * 2, 0.25)
-                continue
-            raw = None
-            try:
-                raw = self.head.object_pull(oid.binary())
-            except RayTaskError as exc:
-                store.put_error(oid, exc)
-                return
-            except Exception as exc:  # head hiccup: retry below
-                log.debug("object pull failed; retrying: %r", exc)
-                raw = None
-            if raw is not None:
-                store.put(oid, SerializedObject.from_bytes(raw))
-                return
-            if store.is_ready(oid):
-                return
-            if time.monotonic() > deadline:
-                raise GetTimeoutError(
-                    f"pull-ref {oid.hex()[:16]}… was not produced within "
-                    f"the dependency wait bound "
-                    f"({GlobalConfig.dep_wait_s:.0f}s, RAY_TPU_DEP_WAIT_S)")
-            if self._stop.is_set():
-                raise GetTimeoutError("node daemon shutting down")
-            local_ready.wait(backoff)
-            backoff = min(backoff * 2, 0.25)
+        owner_id = owner[0] if owner else None
+        owner_addr = tuple(owner[1]) if owner and owner[1] else None
+        self.worker.owner_resolver.resolve(
+            oid.binary(), owner_addr, owner_id, deadline=deadline,
+            stop=self._stop)
 
-    def _unwire_arg(self, wired: tuple, deadline: float | None = None):
+    def _unwire_arg(self, wired: tuple, deadline: float | None = None,
+                    owner: tuple | None = None):
         from ray_tpu._private.serialization import SerializedObject
 
         kind, data = wired
@@ -457,8 +423,8 @@ class NodeDaemon:
                 SerializedObject.from_bytes(data))
         # Pull-ref: prefetched into the store by _start_task.
         oid = ObjectID(bytes(data))
-        self._ensure_object(oid.binary(), deadline)  # no-op when prefetched
-        serialized = self.worker.store.get(oid)
+        self._ensure_object(oid.binary(), deadline, owner)  # no-op when
+        serialized = self.worker.store.get(oid)              # prefetched
         return self.worker.serialization_context.deserialize(serialized)
 
     def _start_task(self, payload: dict):
@@ -500,6 +466,12 @@ class NodeDaemon:
             fn = self._load_fn(payload["fn_digest"],
                                payload.get("_fn_bytes"))
             deadline = time.monotonic() + GlobalConfig.dep_wait_s
+            # The pushing driver OWNS every pull-ref in this payload
+            # (its router inlines foreign-owned values before shipping):
+            # resolve arg locations owner-direct, not through the head.
+            # Owner tuples are (owner_id, addr) everywhere — the same
+            # order serialized refs carry.
+            owner = (payload.get("driver_id"), payload.get("driver_addr"))
             wired = list(payload["args"]) + list(payload["kwargs"].values())
             pull_bins = [bytes(d) for k, d in wired if k == "r"]
             if payload.get("_gated"):
@@ -507,17 +479,17 @@ class NodeDaemon:
                 # wait-out pulls happen inline — the shared pull pool
                 # stays free for immediately-resolvable transfers.
                 for ob in pull_bins:
-                    self._ensure_object(ob, deadline)
+                    self._ensure_object(ob, deadline, owner)
             elif pull_bins:
                 prefetched = prefetch_serialized(
-                    lambda ob: self._ensure_object(ob, deadline),
+                    lambda ob: self._ensure_object(ob, deadline, owner),
                     pull_bins, self._pulls)
                 for exc in prefetched.values():
                     if isinstance(exc, BaseException):
                         raise exc
-            args = tuple(self._unwire_arg(a, deadline)
+            args = tuple(self._unwire_arg(a, deadline, owner)
                          for a in payload["args"])
-            kwargs = {k: self._unwire_arg(v, deadline)
+            kwargs = {k: self._unwire_arg(v, deadline, owner)
                       for k, v in payload["kwargs"].items()}
             spec = TaskSpec(
                 task_id=TaskID(bytes(payload["task_id"])),
@@ -611,15 +583,19 @@ class NodeDaemon:
 
     def _report_loop(self):
         """Drain finished tasks into batched completion reports: ONE
-        coalesced object_announce flight for every result the batch
-        produced (the head's directory still resolves cross-node pulls
-        and head-restart recovery), then ONE vectored task_done batch
-        pushed DIRECT to each driver's object server — the head is out
-        of the steady-state completion path. Head-relayed task_done
-        stays the per-driver fallback (NAT'd drivers, dial failure).
-        Streaming item_done reports ride the same batches: many yields
-        that accumulate while one flush is on the wire coalesce into one
-        vectored flight per driver."""
+        vectored task_done/item_done batch pushed DIRECT to each
+        driver's object server. Under the ownership directory the
+        driver that pushed the task OWNS its results — the direct
+        report IS the location record (the owner's table answers peer
+        ``owner_locate`` queries), so the head sees ZERO steady-state
+        object traffic. Only the per-driver RELAY fallback (NAT'd
+        drivers, dial failure) still announces its batch's locations to
+        the head first — the relayed consumer resolves through the
+        head's fallback directory. Streaming item_done reports ride the
+        same batches: many yields that accumulate while one flush is on
+        the wire coalesce into one vectored flight per driver.
+        ``ownership_directory=false`` restores the pre-ownership
+        announce-everything behavior."""
         from ray_tpu._private.object_server import PeerUnreachableError
 
         while True:
@@ -630,22 +606,20 @@ class NodeDaemon:
                     return
                 items = list(self._report_q)
                 self._report_q.clear()
-            built = []       # ("task_done"/"item_done", bytes, addr, drv)
-            announce = []
+            # ("task_done"/"item_done", bytes, addr, drv, announce_oids)
+            built = []
             for entry in items:
                 try:
                     if entry[0] == "item":
                         _, payload, idx, oid = entry
                         item, ann, addr, drv = self._build_item(
                             payload, idx, oid)
-                        if ann is not None:
-                            announce.append(ann)
-                        built.append(("item_done", item, addr, drv))
+                        built.append(("item_done", item, addr, drv,
+                                      [ann] if ann is not None else []))
                     else:
                         _, payload, return_ids = entry
                         done, oid_bins, addr, drv = self._build_done(
                             payload, return_ids)
-                        announce.extend(oid_bins)
                         built.append(("task_done", done, addr, drv,
                                       oid_bins))
                         if payload.get("streaming"):
@@ -661,29 +635,61 @@ class NodeDaemon:
                 except Exception as exc:  # keep reporting others
                     log.warning("dropping one malformed completion "
                                 "record; reporting the rest: %r", exc)
+            ownership = GlobalConfig.ownership_directory
             announced = True
-            try:
-                self.head.object_announce_many(announce)
-            except Exception as exc:  # head hiccup: take the relay,
-                announced = False     # which re-records locations
-                log.debug("announce batch failed; falling back to "
-                          "relayed completions: %r", exc)
+            if not ownership:
+                # Centralized directory (rollback lever): every result
+                # location coalesces through the head BEFORE completion
+                # reports go out — direct completion is only legal once
+                # the directory can serve later cross-node pulls.
+                announce = [ob for rec in built for ob in rec[4]]
+                try:
+                    self.head.object_announce_many(announce)
+                except Exception as exc:  # head hiccup: take the relay,
+                    announced = False     # which re-records locations
+                    log.debug("announce batch failed; falling back to "
+                              "relayed completions: %r", exc)
             by_driver: Dict[tuple, list] = {}
             for rec in built:
                 by_driver.setdefault((rec[2], rec[3]), []).append(rec)
             for (addr, driver_id), entries in by_driver.items():
-                # Direct completion is only legal once the directory
-                # knows the result locations — otherwise the head-relayed
-                # task_done must carry them (it records owners
-                # server-side), or later cross-node pulls find nothing.
                 if addr is not None and announced:
                     try:
-                        self.head._peers.call_many(
+                        replies = self.head._peers.call_many(
                             addr, [(kind, data)
                                    for kind, data, *_ in entries])
-                        continue
+                        # call_many surfaces DRIVER-side handler errors
+                        # as exception objects per message: those
+                        # records were NOT delivered — they must take
+                        # the relay below or their completion (and only
+                        # location record) is silently lost.
+                        failed = [rec for rec, rep in zip(entries,
+                                                          replies)
+                                  if isinstance(rep, BaseException)]
+                        if not failed:
+                            self.direct_report_batches += 1
+                            continue
+                        log.warning("%d completion record(s) failed in "
+                                    "the driver's handler; relaying "
+                                    "them via the head", len(failed))
+                        entries = failed
                     except PeerUnreachableError:
                         pass  # driver not directly dialable: relay below
+                if ownership:
+                    # Relay fallback under ownership: the head becomes
+                    # the directory of record for THIS batch. The
+                    # task_done relay records its oid locations
+                    # server-side; only large streamed items (announce +
+                    # pull) need the explicit announce flight.
+                    fallback = [ob for rec in entries for ob in rec[4]
+                                if rec[0] == "item_done"]
+                    try:
+                        if fallback:
+                            self.head.object_announce_many(fallback)
+                        self.announce_fallback_oids += len(fallback)
+                    except Exception as exc:  # pub/sub item consumers
+                        log.debug("fallback announce failed (item pulls "
+                                  "resolve via owner only): %r", exc)
                 dones = [(rec[4], rec[1]) for rec in entries
                          if rec[0] == "task_done"]
                 try:

@@ -65,20 +65,29 @@ def wire_arg(router, v):
     return ("v", ctx.serialize(value).to_bytes())
 
 
-def unwire_arg(worker, head, wired):
-    """Host-side inverse: deserialize an inline value, or pull a ref's
-    bytes (p2p from the owning node via the head's location service)."""
+def unwire_arg(worker, head, wired, owner=None):
+    """Host-side inverse: deserialize an inline value, or resolve a
+    ref's bytes through its OWNER (the calling driver — its router
+    tracks the holder; ``owner`` = (owner_id, addr) from the actor-op
+    payload), with the head's fallback directory behind it."""
     kind, data = wired
     if kind == "v":
         return worker.serialization_context.deserialize(
             SerializedObject.from_bytes(bytes(data)))
     oid = ObjectID(bytes(data))
     if not worker.store.is_ready(oid):
-        raw = head.object_pull(oid.binary())
-        if raw is None:
-            raise ValueError(
-                f"pull-ref {oid.hex()[:16]}… has no live owner")
-        worker.store.put(oid, SerializedObject.from_bytes(raw))
+        resolver = getattr(worker, "owner_resolver", None)
+        if resolver is not None:
+            # Owner tuples are (owner_id, addr) project-wide.
+            owner_id = owner[0] if owner else None
+            owner_addr = tuple(owner[1]) if owner and owner[1] else None
+            resolver.resolve(oid.binary(), owner_addr, owner_id)
+        else:  # no resolver (bare runtime): legacy head-directory pull
+            raw = head.object_pull(oid.binary())
+            if raw is None:
+                raise ValueError(
+                    f"pull-ref {oid.hex()[:16]}… has no live owner")
+            worker.store.put(oid, SerializedObject.from_bytes(raw))
     return worker.serialization_context.deserialize(worker.store.get(oid))
 
 
@@ -184,6 +193,7 @@ class RemoteActorRuntime:
                 "max_restarts": self.max_restarts,
                 "runtime_target": self.opts.get("runtime"),
                 "driver_id": self.head.client_id,
+                "driver_addr": list(self.head._object_server.address),
                 "name": self.class_name,
                 "detached": self.opts.get("lifetime") == "detached",
             }, protocol=5)
@@ -250,6 +260,9 @@ class RemoteActorRuntime:
                 "task_id": task_id.binary(),
                 "name": name,
                 "driver_id": self.head.client_id,
+                # Owner identity: the host resolves arg locations and
+                # pushes completion reports owner-direct with this.
+                "driver_addr": list(self.head._object_server.address),
             }, protocol=5)
             self._node_call(payload)
         except BaseException as exc:  # noqa: BLE001 — dispatch boundary
@@ -483,9 +496,10 @@ class ActorHost:
 
         aid = ActorID(bytes(p["actor_id"]))
         cls = cloudpickle.loads(bytes(p["cls"]))
-        args = tuple(unwire_arg(self.worker, self.head, a)
+        owner = (p.get("driver_id"), p.get("driver_addr"))
+        args = tuple(unwire_arg(self.worker, self.head, a, owner)
                      for a in p["args"])
-        kwargs = {k: unwire_arg(self.worker, self.head, v)
+        kwargs = {k: unwire_arg(self.worker, self.head, v, owner)
                   for k, v in p["kwargs"].items()}
         runtime = _ActorRuntime(
             aid, cls, args, kwargs,
@@ -546,9 +560,10 @@ class ActorHost:
                 raise ActorDiedError(
                     aid, getattr(runtime, "death_cause", None)
                     or "actor is not alive on this node")
-            args = tuple(unwire_arg(self.worker, self.head, a)
+            owner = (p.get("driver_id"), p.get("driver_addr"))
+            args = tuple(unwire_arg(self.worker, self.head, a, owner)
                          for a in p["args"])
-            kwargs = {k: unwire_arg(self.worker, self.head, v)
+            kwargs = {k: unwire_arg(self.worker, self.head, v, owner)
                       for k, v in p["kwargs"].items()}
             refs = runtime.submit_prepared(
                 p["method"], args, kwargs, return_ids, p["name"])
@@ -560,8 +575,9 @@ class ActorHost:
                 if not self.worker.store.is_ready(oid):
                     self.worker.store.put_error(oid, err)
         threading.Thread(
-            target=self._report, args=(driver_id, bytes(p["task_id"]),
-                                       return_ids),
+            target=self._report,
+            args=(driver_id, p.get("driver_addr"), bytes(p["task_id"]),
+                  return_ids),
             daemon=True, name="actor-host-report").start()
 
     def _pin(self, refs):
@@ -580,31 +596,50 @@ class ActorHost:
                 else:
                     break
 
-    def _report(self, driver_id: str, task_bin: bytes, return_ids):
-        """Announce finished results and send the completion event. Like
+    def _report(self, driver_id: str, driver_addr, task_bin: bytes,
+                return_ids):
+        """Send the completion event to the OWNING driver — direct to
+        its object server first (the report carries the locations; the
+        owner's directory serves later peer queries, the head stays
+        untouched), head relay as the fallback (which records the
+        locations server-side for the relayed consumer's pulls). Like
         the task plane's reports, small results ride INLINE and errors
         cross as pickled exceptions (no pullable bytes exist for them);
         big results stay pinned here and the driver pulls p2p on
         demand."""
         from ray_tpu._private.node_daemon import completion_fields
+        from ray_tpu._private.object_server import PeerUnreachableError
 
         store = self.worker.store
         store.wait(return_ids, len(return_ids), timeout=None)
         sizes, errs, inline = completion_fields(
             store, return_ids, "actor task")
         oid_bins = [o.binary() for o in return_ids]
+        done = pickle.dumps({
+            "task_id": task_bin,
+            "oid_bins": oid_bins,
+            "node_client": self.head.client_id,
+            "sizes": sizes,
+            "errs": errs,
+            "inline": inline,
+        }, protocol=5)
+        from ray_tpu._private.config import GlobalConfig
+
+        if GlobalConfig.ownership_directory and driver_addr:
+            try:
+                self.head._peers.call(tuple(driver_addr),
+                                      ("task_done", done))
+                return
+            except Exception as exc:  # noqa: BLE001 — NAT'd driver OR a
+                # driver-side handler error: either way the relay below
+                # must still record locations + deliver the completion.
+                log.debug("direct actor task_done push failed; taking "
+                          "the head relay: %r", exc)
         try:
-            # Errored oids announce too: a remote consumer's pull then
-            # raises the typed error instead of retrying to a timeout.
+            # Relay fallback: errored oids announce too, so a remote
+            # consumer's pull raises the typed error instead of
+            # retrying to a timeout.
             self.head.object_announce_many(oid_bins)
-            done = pickle.dumps({
-                "task_id": task_bin,
-                "oid_bins": oid_bins,
-                "node_client": self.head.client_id,
-                "sizes": sizes,
-                "errs": errs,
-                "inline": inline,
-            }, protocol=5)
             self.head.task_done(driver_id, oid_bins, done)
         except Exception:  # noqa: BLE001 — driver/head gone: results stay
             pass

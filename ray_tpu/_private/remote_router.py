@@ -174,6 +174,15 @@ class RemoteRouter:
         # locality scoring colocates a fast chain's links through this
         # map before _task_node registration lands.
         self._task_target: Dict[TaskID, str] = {}
+        # Ownership-based object directory (owner side): this driver
+        # owns every ref its tasks return — the completion stream above
+        # IS the location table, and peers resolve/subscribe against it
+        # over the p2p object plane (``owner_locate``/``owner_notify``)
+        # instead of asking the head. The head keeps only membership +
+        # the fallback directory (lease handoff on shutdown).
+        from ray_tpu._private.ownership import OwnerDirectory
+
+        self.owner_directory = OwnerDirectory(self)
         # Bench counters (the cross-node fast-path proof surface).
         self.direct_pushes = 0     # tasks pushed peer-to-peer
         self.relayed_pushes = 0    # tasks pushed via head relay
@@ -181,6 +190,8 @@ class RemoteRouter:
         self.direct_done_reports = 0   # completions pushed peer-to-peer
         self.relayed_done_reports = 0  # completions via head relay
         self.inline_results = 0    # results that arrived in task_done
+        self.owner_table_pulls = 0  # result pulls resolved from the
+        #                             owner's own table (no head RPC)
         self.fn_bytes_sent = 0     # function bytes actually shipped
         self.fn_payloads_with_bytes = 0
         self.fn_payloads_digest_only = 0
@@ -912,6 +923,8 @@ class RemoteRouter:
             if ev is not None:
                 ev.set()
             self._notify_done(tid)
+            self.owner_directory.publish_many(
+                [o.binary() for o in s.return_ids])
             # Dependents can never run now — fail them too instead of
             # letting their node-side pulls stall to the dep bound.
             for ctid in children:
@@ -985,6 +998,16 @@ class RemoteRouter:
     def _client_alive(self, client_id: str) -> bool:
         return any(n["client_id"] == client_id and n.get("alive")
                    for n in self.nodes())
+
+    def _holder_addr(self, client_id: str) -> Optional[Tuple[str, int]]:
+        """Direct object-server address of the node currently holding
+        an object's bytes (owner directory answers carry this)."""
+        with self._lock:
+            node = self._node_rec.get(client_id)
+        if node is None:
+            node = next((n for n in self.nodes()
+                         if n["client_id"] == client_id), None)
+        return self._node_addr(node) if node else None
 
     # ----------------------------------------------------------- completion
     def _dec_inflight_locked(self, cid: str):
@@ -1073,6 +1096,10 @@ class RemoteRouter:
                 self.inline_results += 1
         ev.set()
         self._notify_done(tid)
+        # Owner directory: wake any peer subscribed to these results
+        # (no-op when nobody asked — the common case).
+        self.owner_directory.publish_many(
+            [bytes(ob) for ob in payload["oid_bins"]])
         if first_exc is not None:
             for ctid in children:
                 self._fail_downstream(ctid, first_exc)
@@ -1133,6 +1160,7 @@ class RemoteRouter:
                 self._oid_sizes[oid.binary()] = size
             stream.known_remote_sizes[int(payload["idx"])] = size
         stream.commit(int(payload["idx"]))
+        self.owner_directory.publish_many([oid.binary()])
         return None
 
     def _stream_node(self, tid: TaskID):
@@ -1236,6 +1264,7 @@ class RemoteRouter:
         tid = object_id.task_id()
         external_deadline = None
         backoff = 0.05
+        next_head_poll = time.monotonic() + 2.0
         while not self.worker.store.is_ready(object_id):
             if deadline is not None and time.monotonic() > deadline:
                 raise GetTimeoutError(
@@ -1260,22 +1289,56 @@ class RemoteRouter:
                 # Event-driven completion wakeup; the bounded wait only
                 # covers the missed-task_done case (head restart).
                 ev.wait(timeout=0.5)
-            # Pull unconditionally each round: the head's object directory
-            # knows completed results even if this driver missed the
-            # task_done event (e.g. across a head restart).
+            # OWNER-table pull first: this driver owns the object and
+            # learned its holder from the direct completion stream — the
+            # transfer is p2p, zero head involvement.
             raw = None
-            try:
-                raw = self.head.object_pull(object_id.binary())
-            except RayTaskError as task_exc:
-                # The owner's store holds the task's ERROR, not bytes —
-                # surface it instead of retrying a pull that can never
-                # produce data (belt-and-braces for a missed errs
-                # payload, e.g. across a head restart).
-                self.worker.store.put_error(object_id, task_exc)
-                return
-            except Exception as exc:  # head hiccup: retry loop
-                log.debug("ensure_local pull failed; retrying: %r", exc)
-                raw = None
+            ob = object_id.binary()
+            with self._lock:
+                holder = self._oid_owner.get(ob)
+            if holder is not None:
+                addr = self._holder_addr(holder)
+                if addr is not None:
+                    raw = self.head._peers.pull_retrying(addr, ob)
+                    if raw is not None:
+                        with self._lock:
+                            self.owner_table_pulls += 1
+                if raw is None and self._client_alive(holder):
+                    # Holder alive but not directly reachable (NAT,
+                    # poisoned lanes): the head relays the bytes from
+                    # the holder WE name — its directory is not
+                    # consulted (the owner's table is the directory).
+                    try:
+                        raw = self.head.object_pull_from(holder, ob)
+                    except RayTaskError as task_exc:
+                        self.worker.store.put_error(object_id, task_exc)
+                        return
+                    except Exception as exc:  # noqa: BLE001 — head busy
+                        log.debug("relay-from-holder pull failed: %r",
+                                  exc)
+                        raw = None
+            done_now = ev is not None and ev.is_set()
+            if raw is None and (done_now
+                                or time.monotonic() >= next_head_poll):
+                # Head FALLBACK directory: relay-path locations, lease-
+                # transferred entries, and the missed-task_done edge
+                # (head restart). While the producer is still running
+                # this is throttled — a pending result must not turn
+                # into a per-round head RPC.
+                next_head_poll = time.monotonic() + 2.0
+                try:
+                    raw = self.head.object_pull(ob)
+                except RayTaskError as task_exc:
+                    # The owner's store holds the task's ERROR, not bytes
+                    # — surface it instead of retrying a pull that can
+                    # never produce data (belt-and-braces for a missed
+                    # errs payload, e.g. across a head restart).
+                    self.worker.store.put_error(object_id, task_exc)
+                    return
+                except Exception as exc:  # head hiccup: retry loop
+                    log.debug("ensure_local pull failed; retrying: %r",
+                              exc)
+                    raw = None
             if raw is not None:
                 self.worker.store.put(
                     object_id, SerializedObject.from_bytes(raw))
@@ -1425,6 +1488,19 @@ class RemoteRouter:
 
     def shutdown(self):
         self._stop.set()
+        # Lease handoff: directory entries that must outlive this owner
+        # (bytes living on cluster nodes) transfer to the head's
+        # fallback directory in ONE coalesced flight, so borrowers of a
+        # gracefully-exited driver keep resolving. A SIGKILLed owner
+        # skips this — its consumers fail typed (OwnerDiedError).
+        if GlobalConfig.ownership_directory:
+            try:
+                entries = self.owner_directory.snapshot_locations()
+                if entries:
+                    self.head.object_transfer_many(entries)
+            except Exception as exc:  # noqa: BLE001 — head gone: the
+                log.debug("lease handoff failed (head unreachable); "
+                          "borrowed refs will fail typed: %r", exc)
         with self._dispatch_cv:
             self._dispatch_cv.notify_all()
         self._pool.shutdown(wait=False, cancel_futures=True)

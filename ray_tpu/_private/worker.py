@@ -129,11 +129,22 @@ class ObjectRef:
 
     def __reduce__(self):
         w = _try_global_worker()
+        owner_info = None
         if w is not None:
             # Borrowed: keep alive for the borrower's lifetime (simplified —
             # the reference tracks borrowers and releases on their exit).
             w.store.add_local_ref(self.object_id)
-        return (_deserialize_ref, (self.object_id,))
+            # Ownership model: a serialized ref carries its OWNER's
+            # identity + direct address, so a foreign deserializer
+            # resolves/subscribes owner-direct instead of polling the
+            # head. A ref this runtime itself borrowed propagates the
+            # ORIGINAL owner, not the forwarder. (Process-plane worker
+            # stubs have no head client — their refs stay owner-less.)
+            hc = getattr(w, "head_client", None)
+            if hc is not None:
+                owner_info = w.borrowed_owner(self.object_id.binary()) \
+                    or (hc.client_id, list(hc._object_server.address))
+        return (_deserialize_ref, (self.object_id, owner_info))
 
     def __del__(self):
         w = self._owner
@@ -153,7 +164,12 @@ class ObjectRef:
         return f"ObjectRef({self.object_id.hex()[:16]}…)"
 
 
-def _deserialize_ref(object_id: ObjectID) -> ObjectRef:
+def _deserialize_ref(object_id: ObjectID, owner_info=None) -> ObjectRef:
+    w = _try_global_worker()
+    if owner_info is not None and w is not None \
+            and getattr(w, "head_client", None) is not None \
+            and owner_info[0] != w.head_client.client_id:
+        w.record_borrowed_owner(object_id.binary(), owner_info)
     return ObjectRef(object_id, _add_ref=False)
 
 
@@ -479,10 +495,18 @@ class Worker:
             self.memory_monitor = MemoryMonitor(
                 self.scheduler,
                 threshold_fraction=GlobalConfig.memory_monitor_threshold)
+        # Ownership plane: owners of refs borrowed FROM other drivers
+        # (recorded at ref deserialization — serialized refs carry their
+        # owner's identity + direct address).
+        self.borrowed_owners: Dict[bytes, tuple] = {}
+        self._borrowed_lock = threading.Lock()
+        self.owner_resolver = None
         if self.head_client is not None:
+            from ray_tpu._private.ownership import OwnerResolver
             from ray_tpu._private.remote_router import RemoteRouter
 
             self.remote_router = RemoteRouter(self)
+            self.owner_resolver = OwnerResolver(self)
         self.submission_counter = _Counter()
         self.put_counter = _Counter()
         self.actor_counter = _Counter()
@@ -542,27 +566,82 @@ class Worker:
 
             self.store.put(object_id, SerializedObject.from_bytes(raw))
 
+    def record_borrowed_owner(self, oid_bin: bytes, owner_info):
+        with self._borrowed_lock:
+            if len(self.borrowed_owners) > 131072:
+                # Hint table only (resolution falls back to the head):
+                # recency-bounded via dict insertion order.
+                self.borrowed_owners.pop(
+                    next(iter(self.borrowed_owners)))
+            self.borrowed_owners[oid_bin] = (
+                owner_info[0], tuple(owner_info[1]))
+
+    def borrowed_owner(self, oid_bin: bytes):
+        with self._borrowed_lock:
+            return self.borrowed_owners.get(oid_bin)
+
     def _pull_wait(self, object_id: ObjectID, timeout: Optional[float]):
-        """Re-polling cross-driver pull: a foreign ref announced AFTER the
-        get starts must still resolve, so keep asking the head inside the
-        wait loop instead of pulling exactly once up front."""
+        """Cross-driver resolve, event-driven end to end: a ref whose
+        OWNER is known (serialized refs carry it) resolves/subscribes
+        owner-direct over the p2p plane; an owner-less foreign ref (hex-
+        constructed) subscribes to the head's ``obj|<hex>`` directory
+        topic and re-pulls on announce — no poll loop either way. A
+        typed ``GetTimeoutError`` materializes at the
+        ``RAY_TPU_DEP_WAIT_S`` bound (or the caller's shorter timeout)."""
         import time as _time
 
-        deadline = None if timeout is None else _time.monotonic() + timeout
-        while not self.store.is_ready(object_id):
-            self._maybe_pull_from_head(object_id)
-            if self.store.is_ready(object_id):
-                return
-            if self.store.has_local_producer(object_id) or \
-                    self.scheduler.lineage_for(object_id.task_id()) \
-                    is not None:
-                return  # locally produced: the plain store wait covers it
-            remaining = 0.25
-            if deadline is not None:
-                remaining = min(0.25, deadline - _time.monotonic())
-                if remaining <= 0:
+        from ray_tpu.exceptions import GetTimeoutError
+
+        if self.store.is_ready(object_id) or \
+                self.store.has_local_producer(object_id) or \
+                self.scheduler.lineage_for(object_id.task_id()) is not None:
+            return  # locally produced: the plain store wait covers it
+        # An EXPLICIT caller timeout is the contract — longer or shorter
+        # than the default wait bound; dep_wait_s only bounds the
+        # unbounded (timeout=None) case.
+        bound = float(GlobalConfig.dep_wait_s) if timeout is None \
+            else float(timeout)
+        deadline = _time.monotonic() + bound
+        owner = self.borrowed_owner(object_id.binary())
+        if owner is not None and self.owner_resolver is not None:
+            self.owner_resolver.resolve(
+                object_id.binary(), owner[1], owner[0], deadline=deadline)
+            return
+        # Owner unknown: head fallback directory. Subscribe BEFORE the
+        # first pull so an announce landing in between still wakes us.
+        import queue as _queue
+
+        sub = None
+        try:
+            try:
+                sub = self.head_client.subscribe(
+                    "obj|" + object_id.binary().hex())
+            except Exception:  # noqa: BLE001 — head hiccup: the bounded
+                sub = None     # store waits below degrade gracefully
+            while True:
+                self._maybe_pull_from_head(object_id)
+                if self.store.is_ready(object_id) or \
+                        self.store.has_local_producer(object_id):
                     return
-            self.store.wait([object_id], 1, remaining)
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(
+                        f"foreign object {object_id.hex()[:16]}… was "
+                        f"never announced/resolvable within "
+                        f"{bound:.0f}s (RAY_TPU_DEP_WAIT_S)")
+                if sub is not None:
+                    try:
+                        sub.get(timeout=min(remaining, 5.0))
+                    except _queue.Empty:
+                        pass  # deadline re-check; no announce yet
+                else:
+                    self.store.wait([object_id], 1, min(remaining, 0.25))
+        finally:
+            if sub is not None:
+                try:
+                    sub.close()
+                except Exception:  # noqa: BLE001 — head gone
+                    pass
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
         router = self.remote_router
@@ -640,7 +719,16 @@ class Worker:
                     router.prefetch(oid)
         if self.head_client is not None:
             for oid in object_ids:
-                self._maybe_pull_from_head(oid)
+                if self.store.is_ready(oid):
+                    continue
+                owner = self.borrowed_owner(oid.binary())
+                if owner is not None and self.owner_resolver is not None:
+                    # Borrowed ref: resolve through its OWNER in the
+                    # background (deduped) — the head's directory never
+                    # saw this object.
+                    self.owner_resolver.prefetch(oid.binary(), owner)
+                else:
+                    self._maybe_pull_from_head(oid)
         return self.store.wait(object_ids, num_returns, timeout)
 
     # -------------------------------------------------------- internal KV ---
@@ -825,9 +913,16 @@ def get(refs: Union[ObjectRef, List[ObjectRef]],
     router = worker.remote_router
     if router is not None:
         for r in refs:
-            if not worker.store.is_ready(r.object_id) \
-                    and router.handles(r.object_id):
+            if worker.store.is_ready(r.object_id):
+                continue
+            if router.handles(r.object_id):
                 router.prefetch(r.object_id)
+            else:
+                owner = worker.borrowed_owner(r.object_id.binary())
+                if owner is not None and \
+                        worker.owner_resolver is not None:
+                    worker.owner_resolver.prefetch(
+                        r.object_id.binary(), owner)
     # One overall deadline across the whole list, not per ref.
     import time as _time
 

@@ -15,6 +15,10 @@ Endpoints:
 - ``GET /api/chaos``    chaos + overload panel: injected wire-fault
   counters per site, NodeKiller kill log, and load-shedding /
   priority-admission stats from serve deployments and LLM engines
+- ``GET /api/head``     ownership-directory panel: the head's per-kind
+  steady-state RPC counts + FT-log appends (the O(membership)-not-
+  O(objects) flatness observable) and this runtime's owner/resolver
+  counters
 """
 
 from __future__ import annotations
@@ -49,6 +53,7 @@ async function refresh() {
     '<h2>workflows</h2>' + table(s.workflows) +
     '<h2>llm engines</h2>' + table(s.llm) +
     '<h2>chaos & shedding</h2>' + table(s.chaos) +
+    '<h2>object directory (ownership)</h2>' + table(s.head) +
     '<h2>workers</h2>' + table(s.workers);
 }
 refresh(); setInterval(refresh, 2000);
@@ -86,6 +91,7 @@ def _snapshot() -> dict:
         "workflows": _workflow_summary(),
         "llm": _llm_summary(),
         "chaos": _chaos_summary(),
+        "head": _head_summary(),
         "workers": {
             "mode": w.worker_mode,
             "pool_size": pool.size if pool is not None else 0,
@@ -149,6 +155,17 @@ def _chaos_summary() -> dict:
         return {"error": repr(exc)}
 
 
+def _head_summary() -> dict:
+    """Ownership-directory panel: head steady-state RPC/log counters +
+    local owner/resolver counters (local-only view without a head)."""
+    try:
+        from ray_tpu.util.state import ownership_summary
+
+        return ownership_summary()
+    except Exception as exc:  # noqa: BLE001 — panel must not kill page
+        return {"error": repr(exc)}
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
@@ -188,6 +205,12 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util.state import chaos_summary
 
                 payload = json.dumps(chaos_summary(),
+                                     default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/head"):
+                from ray_tpu.util.state import ownership_summary
+
+                payload = json.dumps(ownership_summary(),
                                      default=str).encode()
                 ctype = "application/json"
             else:

@@ -3,6 +3,7 @@ them with the checker registry."""
 
 from ray_tpu.devtools.raylint.checks import (  # noqa: F401
     counter_balance,
+    directory_discipline,
     exception_discipline,
     flag_hygiene,
     lock_discipline,

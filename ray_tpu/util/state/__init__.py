@@ -259,6 +259,43 @@ def chaos_summary() -> Dict[str, Any]:
     return out
 
 
+def ownership_summary() -> Dict[str, Any]:
+    """Ownership-directory panel (`/api/head` role): the head's
+    steady-state RPC + FT-log-append counters — the PRODUCTION
+    observables behind the "head stays O(membership), not O(objects)"
+    claim — plus this runtime's owner/resolver counters (locations
+    tracked, owner-direct locates/pulls served, head-fallback pulls).
+    Safe without a head (local-only runtimes report their side only)."""
+    from ray_tpu._private.config import GlobalConfig
+
+    w = global_worker()
+    out: Dict[str, Any] = {
+        "ownership_directory": bool(GlobalConfig.ownership_directory),
+    }
+    router = w.remote_router
+    if router is not None:
+        directory = router.owner_directory
+        with router._lock:
+            tracked = len(router._oid_owner)
+        out["owner"] = {
+            "locations_tracked": tracked,
+            "locates_served": directory.locates_served,
+            "notifies_sent": directory.notifies_sent,
+            "owner_table_pulls": router.owner_table_pulls,
+            "direct_done_reports": router.direct_done_reports,
+            "relayed_done_reports": router.relayed_done_reports,
+        }
+    resolver = getattr(w, "owner_resolver", None)
+    if resolver is not None:
+        out["resolver"] = resolver.counters()
+    if w.head_client is not None:
+        try:
+            out["head"] = w.head_client.head_stats()
+        except Exception as exc:  # noqa: BLE001 — head down: local view
+            out["head"] = {"error": repr(exc)}
+    return out
+
+
 def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
     from ray_tpu.util.placement_group import placement_group_table
 

@@ -89,7 +89,14 @@ DEFAULT_REQUIRED = ("cluster_fanout_1k.tasks_per_sec,"
                     "streaming.backpressured_items_per_sec,"
                     "llm_serving.continuous_tokens_per_sec,"
                     "llm_prefix.cached_tokens_per_sec,"
-                    "chaos_slo.p99_ttft_under_kill")
+                    "chaos_slo.p99_ttft_under_kill,"
+                    "ownership.head_rpcs_per_1k_objects")
+
+# Flatness metrics (ownership directory): ABSOLUTE gate, not relative —
+# the head's marginal steady-state cost per 1k objects must stay ~0
+# (O(membership), not O(objects)); any prior-record ratchet could creep.
+_FLATNESS_SUFFIX = "_per_1k_objects"
+_FLATNESS_MAX = 1.0
 
 
 def check_required(paths: list, curr: dict, threshold: float,
@@ -103,6 +110,12 @@ def check_required(paths: list, curr: dict, threshold: float,
             failures.append(
                 f"required metric {key!r} missing from the newest record "
                 f"(suite skipped?)")
+            continue
+        if key.endswith(_FLATNESS_SUFFIX) and cm[key] > _FLATNESS_MAX:
+            failures.append(
+                f"{key}: {cm[key]:.2f} > {_FLATNESS_MAX} — the head's "
+                f"steady-state object plane is no longer flat in object "
+                f"count (ownership directory regression)")
             continue
         for path in reversed(paths[:-1]):
             with open(path) as f:

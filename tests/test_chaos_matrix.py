@@ -320,6 +320,7 @@ def test_ensure_local_materializes_object_lost_when_unrecoverable():
     router._lock = threading.Lock()
     router._done = {}
     router._failed = {}
+    router._oid_owner = {}
     router._prefetching = set()
     router._stop = threading.Event()
     router.external = set()
@@ -917,3 +918,132 @@ def test_sweep_worker_kill_x_workflow_exactly_once(tmp_path):
         assert workflow.get_output("chaos_wf") == 15
     finally:
         ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Ownership axis: owner death x borrowed-ref consumers (PR 10 rows).
+# --------------------------------------------------------------------------
+_OWNER_DRIVER = r"""
+import sys, time
+import cloudpickle
+import ray_tpu
+
+address, mode = sys.argv[1], sys.argv[2]
+ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+             address=address)
+w = ray_tpu._private.worker.global_worker()
+
+@ray_tpu.remote
+def blob(i):
+    return bytes(300_000) + bytes([i])  # > inline cap: bytes stay node-side
+
+refs = [blob.remote(i) for i in range(6)]
+ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+w.kv_put(b"ownchaos/refs", cloudpickle.dumps(refs))
+w.kv_put(b"ownchaos/ready", b"1")
+if mode == "graceful":
+    # Lease handoff: router.shutdown transfers the owner's location
+    # table to the head before the process exits.
+    ray_tpu.shutdown()
+    sys.exit(0)
+while True:  # hold ownership until SIGKILLed by the test
+    time.sleep(0.2)
+"""
+
+
+def _wait_kv_poll(worker, key, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = worker.kv_get(key)
+        if v is not None:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"kv key {key} never appeared")
+
+
+def _wait_client_gone(worker, client_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if client_id not in worker.head_client.cluster_info()["clients"]:
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"head never declared {client_id} dead")
+
+
+@pytest.mark.slow
+def test_matrix_owner_kill9_x_borrowed_refs_typed(tmp_path):
+    """Cell (owner SIGKILL × borrowed-ref consumer): driver A fans out
+    onto a real node, its refs are borrowed by driver B, A dies -9
+    WITHOUT a lease handoff — B's gets fail typed
+    (OwnerDiedError/ObjectLostError), never an unbounded poll."""
+    import pickle as _pickle
+    import subprocess
+    import sys as _sys
+
+    from ray_tpu.exceptions import ObjectLostError
+
+    ray_tpu.shutdown()
+    head, address, nodes = _spawn_cluster(tmp_path, n_nodes=1)
+    owner = None
+    try:
+        owner = subprocess.Popen(
+            [_sys.executable, "-c", _OWNER_DRIVER, address, "hold"],
+            env=_spawn_env())
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+        w = ray_tpu._private.worker.global_worker()
+        _wait_kv_poll(w, b"ownchaos/ready")
+        refs = _pickle.loads(w.kv_get(b"ownchaos/refs"))
+        owner_id = w.borrowed_owner(refs[0].object_id.binary())[0]
+        owner.kill()
+        owner.wait(timeout=5)
+        _wait_client_gone(w, owner_id)
+        t0 = time.monotonic()
+        for ref in refs[:3]:
+            with pytest.raises(ObjectLostError):  # OwnerDiedError is-a
+                ray_tpu.get(ref, timeout=60)
+        assert time.monotonic() - t0 < 60, "loss was not typed promptly"
+        res = w.owner_resolver.counters()
+        assert res["owner_died_errors"] >= 1
+    finally:
+        ray_tpu.shutdown()
+        for p in [owner] + nodes + [head]:
+            if p is not None:
+                p.kill()
+                p.wait(timeout=5)
+
+
+@pytest.mark.slow
+def test_matrix_owner_graceful_exit_x_lease_handoff_resolves(tmp_path):
+    """Cell (owner graceful exit × borrowed-ref consumer): the same
+    topology, but A exits cleanly — its location table lease-transfers
+    to the head, so B's borrowed refs still resolve (head fallback →
+    p2p pull from the holding node) after the owner is gone."""
+    import pickle as _pickle
+    import subprocess
+    import sys as _sys
+
+    ray_tpu.shutdown()
+    head, address, nodes = _spawn_cluster(tmp_path, n_nodes=1)
+    owner = None
+    try:
+        owner = subprocess.Popen(
+            [_sys.executable, "-c", _OWNER_DRIVER, address, "graceful"],
+            env=_spawn_env())
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+        w = ray_tpu._private.worker.global_worker()
+        _wait_kv_poll(w, b"ownchaos/ready")
+        refs = _pickle.loads(w.kv_get(b"ownchaos/refs"))
+        owner_id = w.borrowed_owner(refs[0].object_id.binary())[0]
+        owner.wait(timeout=30)  # graceful exit ran the lease handoff
+        _wait_client_gone(w, owner_id)
+        for i, ref in enumerate(refs):
+            value = ray_tpu.get(ref, timeout=60)
+            assert value == bytes(300_000) + bytes([i])
+    finally:
+        ray_tpu.shutdown()
+        for p in [owner] + nodes + [head]:
+            if p is not None:
+                p.kill()
+                p.wait(timeout=5)

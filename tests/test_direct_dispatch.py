@@ -48,6 +48,13 @@ def _bare_router():
     r._dep_children = {}
     r.lineage = {}
     r.external = {}
+
+    class _NoopDirectory:
+        @staticmethod
+        def publish_many(oid_bins):
+            pass
+
+    r.owner_directory = _NoopDirectory()
     return r
 
 
@@ -309,15 +316,34 @@ def test_check_bench_requires_cluster_metric(tmp_path):
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     # Every required metric present and holding -> gate passes (PR 5
     # adds llm_serving.continuous_tokens_per_sec, PR 7 adds
-    # llm_prefix.cached_tokens_per_sec, and PR 8 adds
-    # chaos_slo.p99_ttft_under_kill to the required set).
+    # llm_prefix.cached_tokens_per_sec, PR 8 adds
+    # chaos_slo.p99_ttft_under_kill, and PR 10 adds the ownership
+    # flatness headline to the required set).
     _write("BENCH_pr03.json",
            {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
             "streaming": {"backpressured_items_per_sec": 150.0},
             "llm_serving": {"continuous_tokens_per_sec": 1000.0},
             "llm_prefix": {"cached_tokens_per_sec": 400.0},
-            "chaos_slo": {"p99_ttft_under_kill": 30.0}})
+            "chaos_slo": {"p99_ttft_under_kill": 30.0},
+            "ownership": {"head_rpcs_per_1k_objects": 0.0}})
     assert check_bench.main(["--dir", str(tmp_path)]) == 0
+    # Flatness is an ABSOLUTE gate: a head back in the object plane
+    # (nonzero marginal RPCs per 1k objects) fails even with no prior.
+    _write("BENCH_pr03.json",
+           {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
+            "streaming": {"backpressured_items_per_sec": 150.0},
+            "llm_serving": {"continuous_tokens_per_sec": 1000.0},
+            "llm_prefix": {"cached_tokens_per_sec": 400.0},
+            "chaos_slo": {"p99_ttft_under_kill": 30.0},
+            "ownership": {"head_rpcs_per_1k_objects": 42.0}})
+    assert check_bench.main(["--dir", str(tmp_path)]) == 1
+    _write("BENCH_pr03.json",
+           {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
+            "streaming": {"backpressured_items_per_sec": 150.0},
+            "llm_serving": {"continuous_tokens_per_sec": 1000.0},
+            "llm_prefix": {"cached_tokens_per_sec": 400.0},
+            "chaos_slo": {"p99_ttft_under_kill": 30.0},
+            "ownership": {"head_rpcs_per_1k_objects": 0.0}})
     # A later record whose streaming throughput regressed vs the last
     # record carrying it -> gate fails.
     _write("BENCH_pr04.json",
@@ -325,7 +351,8 @@ def test_check_bench_requires_cluster_metric(tmp_path):
             "streaming": {"backpressured_items_per_sec": 60.0},
             "llm_serving": {"continuous_tokens_per_sec": 1000.0},
             "llm_prefix": {"cached_tokens_per_sec": 400.0},
-            "chaos_slo": {"p99_ttft_under_kill": 30.0}})
+            "chaos_slo": {"p99_ttft_under_kill": 30.0},
+            "ownership": {"head_rpcs_per_1k_objects": 0.0}})
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     assert key  # silence linters: key documents the gated metric
 

@@ -311,8 +311,13 @@ def test_direct_peer_object_pull(two_node_cluster):
     out = ray_tpu.get(ref, timeout=60)
     assert out["blob"][-1] == 49_999
     w = ray_tpu._private.worker.global_worker()
-    assert w.head_client.direct_pulls > 0, (
-        w.head_client.direct_pulls, w.head_client.relayed_pulls)
+    # Ownership directory: the driver resolves the holder from its OWN
+    # location table (owner_table_pulls); head-located direct pulls
+    # (direct_pulls) cover the pre-ownership/fallback directory path.
+    p2p = w.remote_router.owner_table_pulls + w.head_client.direct_pulls
+    assert p2p > 0, (
+        w.remote_router.owner_table_pulls, w.head_client.direct_pulls,
+        w.head_client.relayed_pulls)
 
 
 def test_peer_pull_falls_back_to_relay(two_node_cluster):

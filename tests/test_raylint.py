@@ -557,10 +557,76 @@ def test_whole_tree_zero_non_baselined_findings():
         f"analysis took {result.elapsed_s:.1f}s (budget 30s)"
 
 
-def test_all_five_passes_registered():
+def test_all_six_passes_registered():
     assert {"lock-discipline", "counter-balance",
             "exception-discipline", "flag-hygiene",
-            "thread-hygiene"} <= set(CHECKERS)
+            "thread-hygiene", "directory-discipline"} <= set(CHECKERS)
+
+
+# ------------------------------------------------------ directory-discipline
+DIRECTORY_VIOLATION = """
+    class Reporter:
+        def __init__(self, head):
+            self.head = head
+
+        def report(self, oids):
+            self.head.object_announce_many(oids)
+"""
+
+
+def test_directory_discipline_fires(tmp_path):
+    findings = run_fixture(tmp_path, DIRECTORY_VIOLATION,
+                           ["directory-discipline"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.check == "directory-discipline"
+    assert f.detail == "rpc:object_announce_many"
+    assert f.scope == "Reporter.report"
+    assert "fallback" in f.message
+
+
+def test_directory_discipline_suppressed(tmp_path):
+    src = DIRECTORY_VIOLATION.replace(
+        "self.head.object_announce_many(oids)",
+        "self.head.object_announce_many(oids)"
+        "  # raylint: disable=directory-discipline")
+    assert run_fixture(tmp_path, src, ["directory-discipline"]) == []
+
+
+def test_directory_discipline_wire_literals_and_defs_are_clean(tmp_path):
+    """The client method DEFINITIONS and the wire-kind tuple literals
+    are not call sites — only attribute calls fire."""
+    src = """
+        class HeadClientish:
+            def object_announce(self, oid):
+                return self._request(("object_announce", oid))
+
+            def object_pull(self, oid):
+                return self._request(("object_locate", oid))
+    """
+    assert run_fixture(tmp_path, src, ["directory-discipline"]) == []
+
+
+def test_directory_discipline_allowlist_exempts_real_fallbacks():
+    """The real tree's deliberate fallback sites are enumerated in the
+    allowlist, so the check's committed baseline is EMPTY — any new
+    centralized-directory call is a gate failure, not a baseline
+    entry."""
+    result = run_analysis(["ray_tpu"], REPO_ROOT,
+                          checks=["directory-discipline"],
+                          ctx=AnalysisContext(root=REPO_ROOT))
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+    # And the allowlisted sites actually exist: a refactor that moves a
+    # fallback must move its allowlist entry too (stale entries would
+    # quietly widen the allowed surface).
+    from ray_tpu.devtools.raylint.checks.directory_discipline import (
+        ALLOWED_FALLBACK_SITES,
+        DIRECTORY_RPCS,
+    )
+
+    for _, _, method in ALLOWED_FALLBACK_SITES:
+        assert method in DIRECTORY_RPCS
 
 
 def test_cli_checks_subset_respects_other_checks_baseline(tmp_path):
