@@ -1,7 +1,8 @@
 PYTHON ?= python
 
 .PHONY: test analyze bench bench-control-plane bench-llm \
-	bench-llm-prefix bench-gate bench-chaos bench-ownership chaos-gate
+	bench-llm-prefix bench-gate bench-chaos bench-ownership \
+	bench-elastic chaos-gate
 
 test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -53,6 +54,16 @@ bench-chaos:
 # an ABSOLUTE <= 1.0 gate.
 bench-ownership:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite ownership
+
+# Elastic production episode: a ramp->spike->fall traffic shape (the
+# seeded loadgen DSL) against an autoscaled LLM serving deployment on
+# REAL autoscaler-launched nodes, with the seeded NodeKiller killing a
+# node mid-ramp and wire faults armed; records p99 TTFT under scale,
+# p99 cold start (node launch -> first token), drain-before-reap
+# counters, and the scale-to-zero wake latency. One JSON line;
+# elastic_slo.p99_ttft_under_scale is REQUIRED by check_bench.
+bench-elastic:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite elastic_slo
 
 # Deterministic chaos slice inside tier-1 time: the seeded fault-
 # injection / NodeKiller / shedding matrix cells (pytest -m chaos,

@@ -1533,6 +1533,381 @@ def bench_chaos_slo(n_high=180, n_low=40, max_new=4):
     }
 
 
+def bench_elastic_slo(n_low=12, max_new=4):
+    """Config #14: the ELASTIC production loop (PR 12) — elasticity x
+    chaos x load as ONE episode. A seeded ramp->spike->fall traffic
+    shape (util/loadgen DSL) drives an autoscaled LLM serving
+    deployment whose replicas demand real CPUs, so replica scale-up
+    LAUNCHES real node-daemon processes through ClusterAutoscaler +
+    LocalSubprocessProvider; the seeded NodeKiller SIGKILLs one
+    launched node mid-ramp and seeded wire faults stay armed on the
+    peer plane for the whole episode. Measured:
+
+    - p99 TTFT for class-0 streams across the episode, from each
+      stream's FIRST submit attempt (cold starts, shed-retry queueing,
+      kill recovery and reroute latency all inside the number) —
+      ``elastic_slo.p99_ttft_under_scale`` is bench-gate REQUIRED;
+    - p99 COLD START: autoscaler launch decision -> first token served
+      by a replica born after it (same-machine monotonic clock), with
+      prefix-cache warming + function pre-ship attacking it;
+    - effective success rate (completions / (total - shed-by-policy)),
+      asserted >= 0.99, with ZERO ObjectLostError/OwnerDiedError;
+    - the fall: replicas scale to zero, idle nodes DRAIN-before-reap
+      (counters disclosed), then one wake request measures the
+      scale-from-zero wake wall (bounded).
+    """
+    import os
+    import subprocess
+    import threading
+
+    import jax.numpy as jnp
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # Seeded wire faults, inherited by every launched node daemon.
+    chaos_json = ('{"seed": 12, "delay": 0.08, "delay_ms": 2, '
+                  '"dup": 0.01, "sites": ["peer"]}')
+    env["RAY_TPU_CHAOS"] = chaos_json
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.autoscaler import (
+        ClusterAutoscaler,
+        LocalSubprocessProvider,
+        NodeTypeConfig,
+    )
+    from ray_tpu.exceptions import (
+        ObjectLostError,
+        OwnerDiedError,
+        RequestSheddedError,
+    )
+    from ray_tpu.llm import EngineConfig
+    from ray_tpu.llm.api import build_llm_app
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.util import chaos as chaos_util
+    from ray_tpu.util import loadgen
+    from ray_tpu._private.config import GlobalConfig
+
+    GlobalConfig.set("serve_wake_timeout_s", 180.0)
+    os.environ["RAY_TPU_CHAOS"] = chaos_json
+    injector = chaos_util.install_from_env()
+    procs = []
+    scaler = None
+    result = {"suite": "elastic_slo"}
+    try:
+        head = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", "0"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(head)
+        line = head.stdout.readline()
+        assert "listening" in line, f"head failed to start: {line!r}"
+        address = line.strip().rsplit(" ", 1)[-1]
+        # Zero local CPUs: every replica's {CPU: 1} demand is
+        # infeasible on the driver, so replica scale-up MUST launch
+        # real nodes.
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+        scaler = ClusterAutoscaler(
+            address,
+            [NodeTypeConfig("serve", {"CPU": 2}, min_workers=0,
+                            max_workers=3)],
+            provider=LocalSubprocessProvider(
+                address, worker_mode="thread", env=env),
+            idle_timeout_s=8.0, update_interval_s=0.5)
+
+        serve.start()
+        mcfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=1, n_heads=4,
+            n_kv_heads=2, d_ff=64, dtype=jnp.float32)
+        shared_prefix = [1 + ((i * 5) % 120) for i in range(16)]
+        ecfg = EngineConfig(
+            model=mcfg, num_blocks=256, block_size=8, max_num_seqs=8,
+            prefill_token_budget=256, max_queued_requests=256,
+            max_new_tokens_default=max_new)
+        max_ongoing = 48
+        app = build_llm_app(
+            ecfg, name="elastic_llm", num_replicas=1,
+            autoscaling_config={
+                "min_replicas": 0, "max_replicas": 3,
+                "target_ongoing_requests": 3.0,
+                # Downscale slower than the ramp's arrival gaps: the
+                # tail still reaches zero, but a lull between two ramp
+                # arrivals must not cold-cycle the whole deployment.
+                "upscale_delay_s": 0.5, "downscale_delay_s": 8.0},
+            max_ongoing_requests=max_ongoing,
+            warm_prefix=shared_prefix,
+            ray_actor_options={"num_cpus": 1})
+        handle = serve.run(app)
+        ctl = serve.api.get_or_create_controller()
+        rng = __import__("random").Random(0)
+
+        def prompt(i):
+            return shared_prefix + [1 + (7 * i) % 120 for _ in range(4)]
+
+        episode_deadline = time.monotonic() + 420.0
+        counters_lock = threading.Lock()
+        first_tokens = [0]
+        kill_gate = threading.Event()
+        results = []  # (cls, outcome, ttft_or_None, errtype_or_None)
+
+        def run_stream(i, cls):
+            req = {"prompt": prompt(i), "max_new_tokens": max_new,
+                   "priority": cls}
+            t0 = time.perf_counter()
+            while time.monotonic() < episode_deadline:
+                try:
+                    gen = handle.options(stream=True,
+                                         priority=cls).remote(req)
+                    toks = []
+                    for tok in gen:
+                        if not toks:
+                            ttft = time.perf_counter() - t0
+                            with counters_lock:
+                                first_tokens[0] += 1
+                                if first_tokens[0] >= 8:
+                                    kill_gate.set()
+                        toks.append(tok)
+                    if len(toks) == max_new:
+                        results.append((cls, "ok", ttft, None))
+                        return "ok"
+                except RequestSheddedError:
+                    if cls != 0:
+                        results.append((cls, "shed", None, None))
+                        return "shed"
+                    time.sleep(0.3 * (0.5 + rng.random()))
+                except (ObjectLostError, OwnerDiedError) as exc:
+                    # The acceptance criterion: drain-before-reap and
+                    # lease transfer mean these must NEVER surface.
+                    results.append((cls, "ref_lost", None,
+                                    type(exc).__name__))
+                    return "ref_lost"
+                except Exception:  # noqa: BLE001 — kill fallout: retry
+                    time.sleep(0.3 * (0.5 + rng.random()))
+            results.append((cls, "timeout", None, None))
+            return "timeout"
+
+        # Seeded killer: SIGKILL one autoscaler-launched node daemon
+        # once the ramp is mid-flight (8 first tokens served).
+        def victim_pid():
+            with scaler._lock:
+                for m in scaler._managed:
+                    proc = (m.handle or {}).get("proc")
+                    if proc is not None and proc.poll() is None:
+                        return proc.pid
+            return None
+
+        killer = chaos_util.NodeKiller(
+            [chaos_util.pid_kill_target("elastic_node", victim_pid,
+                                        kind="daemon", once=True)],
+            seed=12, interval_s=(0.01, 0.05), max_kills=1)
+
+        def arm_killer():
+            if kill_gate.wait(timeout=300):
+                killer.start()
+
+        threading.Thread(target=arm_killer, daemon=True).start()
+
+        # Replica-stats sampler: cold-start timestamps must survive the
+        # replicas themselves (scale-to-zero kills them at the tail) —
+        # sample every live replica's stats through the episode and
+        # keep the last report per replica identity.
+        sampled_stats: dict = {}
+        sampler_stop = threading.Event()
+
+        def sample_stats():
+            while not sampler_stop.wait(1.0):
+                with ctl._lock:
+                    info = ctl._deployments.get("elastic_llm")
+                    replicas = list(info.replicas) if info else []
+                for r in replicas:
+                    try:
+                        st = ray_tpu.get(
+                            r.handle_request.remote("stats", (), {}),
+                            timeout=5.0)
+                        # Keyed by the STABLE actor id (id(r) recycles
+                        # after GC and would let a new replica clobber
+                        # a dead one's final cold-start timestamps).
+                        key = getattr(
+                            getattr(r, "_runtime", None), "actor_id",
+                            None)
+                        sampled_stats[
+                            key.binary() if key is not None
+                            else id(r)] = st
+                    except Exception:  # noqa: BLE001 — dying replica
+                        pass
+
+        sampler = threading.Thread(target=sample_stats, daemon=True)
+        sampler.start()
+
+        # The episode: ramp -> spike -> fall, seeded + replayable.
+        shape = (loadgen.Ramp(0.4, 3.0, 15.0)
+                 >> loadgen.Spike(6.0, 5.0)
+                 >> loadgen.Ramp(3.0, 0.3, 10.0))
+        gen = loadgen.LoadGenerator(
+            shape, lambda i, t: run_stream(i, 0), seed=12,
+            max_concurrency=96)
+        # Low-priority side traffic (one-shot; shed-by-policy is the
+        # expected outcome under the spike).
+        low_threads = [
+            threading.Thread(target=run_stream, args=(10_000 + i, 3),
+                             daemon=True) for i in range(n_low)]
+        t_episode = time.perf_counter()
+
+        def start_low():
+            time.sleep(shape.phases[0].duration_s)  # spike-aligned
+            for t in low_threads:
+                t.start()
+
+        threading.Thread(target=start_low, daemon=True).start()
+        gen.run(timeout_s=400)
+        for t in low_threads:
+            t.join(120)
+        episode_wall = time.perf_counter() - t_episode
+        killer.stop()
+        kills = [k for k in killer.kills if "error" not in k]
+        assert kills, "the mid-ramp node kill never fired"
+
+        # Cold starts: pair autoscaler launches with replicas born
+        # after them (first REAL token on the shared monotonic clock).
+        sampler_stop.set()
+        sampler.join(10)
+        replica_stats = list(sampled_stats.values())
+        scale_events = scaler.summary()["scale_events"]
+        cold_starts = []
+        for ev in scale_events:
+            if ev.get("joined") is None:
+                continue
+            cands = [st["first_token_monotonic"] for st in replica_stats
+                     if st.get("first_token_monotonic") is not None
+                     and st.get("init_started_monotonic", 0)
+                     >= ev["launch_started"]]
+            if cands:
+                cold_starts.append(min(cands) - ev["launch_started"])
+        cold_starts.sort()
+
+        # The fall: deployment scales to zero, idle nodes drain + reap.
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 90:
+            st = serve.status()["elastic_llm"]
+            if st["replicas"] == 0 and st["target_replicas"] == 0 \
+                    and scaler.summary()["managed_nodes"] == 0:
+                break
+            time.sleep(0.5)
+        post_fall = {
+            "replicas": serve.status()["elastic_llm"]["replicas"],
+            "managed_nodes": scaler.summary()["managed_nodes"],
+        }
+
+        # Episode stats snapshot BEFORE the wake probe: the wake's TTFT
+        # is a scale-from-zero wall (its own metric below) — letting it
+        # into the episode sample would make the gated p99 a duplicate
+        # of the wake wall instead of TTFT-under-scale.
+        episode_results = list(results)
+
+        # Scale-from-zero wake: one request relaunches the loop
+        # (replica target 0 -> 1, node launch, engine init, tokens).
+        # Fresh retry budget: the episode deadline may be nearly spent
+        # after a slow traffic phase + fall wait.
+        episode_deadline = time.monotonic() + 180.0
+        t0 = time.perf_counter()
+        wake_outcome = run_stream(99_999, 0)
+        wake_wall = time.perf_counter() - t0
+
+        ok_high = sorted(t for c, o, t, _ in episode_results
+                         if c == 0 and o == "ok")
+        ok_low = sum(1 for c, o, _, _ in episode_results
+                     if c == 3 and o == "ok")
+        shed_low = sum(1 for c, o, _, _ in episode_results
+                       if c == 3 and o == "shed")
+        ref_lost = [e for _, o, _, e in results if o == "ref_lost"]
+        failed = sum(1 for _, o, _, _ in episode_results
+                     if o in ("timeout", "ref_lost"))
+        total = len(episode_results)
+        effective_denom = max(total - shed_low, 1)
+        success = (len(ok_high) + ok_low) / effective_denom
+        assert not ref_lost, (
+            f"drain-before-reap violated: typed ref-loss errors "
+            f"surfaced in the episode: {ref_lost}")
+        assert success >= 0.99, (
+            f"effective success {success:.3f} < 0.99 "
+            f"(failed={failed}, shed={shed_low})")
+        assert wake_outcome == "ok", f"wake request: {wake_outcome}"
+
+        p99 = ok_high[min(len(ok_high) - 1, int(len(ok_high) * 0.99))]
+        p50 = ok_high[len(ok_high) // 2]
+        summary = scaler.summary()
+        serve_st = serve.status()["elastic_llm"]
+        router = ray_tpu._private.worker.global_worker().remote_router
+        result.update({
+            "traffic_shape": shape.describe(),
+            "seed": 12,
+            "scheduled_requests": len(gen.schedule),
+            "n_low_priority": n_low,
+            "max_new_tokens": max_new,
+            "episode_wall_s": episode_wall,
+            "p99_ttft_under_scale": p99,
+            "p50_ttft_under_scale": p50,
+            "effective_success_rate": success,
+            "completed_high": len(ok_high),
+            "completed_low": ok_low,
+            "shed_by_policy": shed_low,
+            "failed": failed,
+            "ref_lost_errors": len(ref_lost),
+            "kills": kills,
+            "nodes_launched": len(summary["launched"]),
+            "nodes_terminated": len(summary["terminated"]),
+            "launch_attempts": summary["launch_attempts"],
+            "launch_failures": summary["launch_failures"],
+            "drained_nodes": summary["drained_nodes"],
+            "drain_transferred_objects":
+                summary["drain_transferred_objects"],
+            "drain_reroutes": router.drain_reroutes,
+            "fn_preship_sent": router.fn_preship_sent,
+            "cold_starts_s": cold_starts,
+            "p99_cold_start_s": (
+                cold_starts[min(len(cold_starts) - 1,
+                                int(len(cold_starts) * 0.99))]
+                if cold_starts else None),
+            "post_fall": post_fall,
+            "wake_events": serve_st["wake_events"],
+            "scale_to_zero_wake_wall_s": wake_wall,
+            "warmed_prefix_tokens_per_replica": [
+                st.get("warmed_prefix_tokens") for st in replica_stats],
+            "wire_fault_counters": chaos_util.wire_counters(),
+            "timing": ("one seeded open-loop episode, CPU backend, "
+                       "real head + autoscaler-launched node daemons, "
+                       "TTFT from first submit attempt (cold starts, "
+                       "shed retries and kill recovery included); one "
+                       "launched node SIGKILLed mid-ramp, wire "
+                       "delay/dup armed on the peer plane throughout"),
+        })
+    finally:
+        try:
+            if scaler is not None:
+                scaler.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        chaos_util.uninstall()
+        os.environ.pop("RAY_TPU_CHAOS", None)
+        for p in reversed(procs):
+            p.kill()
+            p.wait(timeout=5)
+    return result
+
+
 def bench_rl_rollout(repeats=6):
     """Config #5: PPO rollout collection, CartPole, 64 vectorized envs.
     Marginal-timed via fresh-process probes (honest-timing note at
@@ -1755,7 +2130,7 @@ def main():
     parser.add_argument("--suite", choices=[
         "chain", "fanout", "actor", "data", "rl", "model", "sharded",
         "control_plane", "workflow", "streaming", "llm_serving",
-        "llm_prefix", "chaos_slo", "ownership"],
+        "llm_prefix", "chaos_slo", "ownership", "elastic_slo"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -1782,6 +2157,7 @@ def main():
         "llm_prefix": bench_llm_prefix,
         "chaos_slo": bench_chaos_slo,
         "ownership": bench_ownership,
+        "elastic_slo": bench_elastic_slo,
     }
 
     if args.suite:

@@ -216,7 +216,10 @@ class ChaosInjector:
 
 
 # ------------------------------------------------------------ installation
-_lock = threading.Lock()
+# Re-entrant: install() can trigger transport's FIRST import, whose
+# module bootstrap (RAY_TPU_CHAOS set) calls install_from_env -> install
+# on the same thread — a plain Lock self-deadlocks that stack.
+_lock = threading.RLock()
 
 
 def _transport():

@@ -226,3 +226,25 @@ _D("ownership_directory", bool, True,
 _D("head_log_compact_records", int, 50000,
    "Compact the head's append-only state log once it holds this many "
    "records (snapshot + truncate; 0 disables compaction).")
+_D("autoscaler_launch_retries", int, 3,
+   "Provider node launches retry up to this many times (jittered "
+   "exponential backoff) before the autoscaler surfaces a typed "
+   "NodeLaunchFailedError instead of silent membership absence.")
+_D("autoscaler_launch_backoff_s", float, 0.5,
+   "Base backoff between node-launch attempts (doubled per attempt, "
+   "jittered x0.5-1.5 so concurrent launch storms spread).")
+_D("autoscaler_launch_grace_s", float, 60.0,
+   "Grace window for a LAUNCHING node: from process start until this "
+   "many seconds pass, a node absent from head membership is treated "
+   "as still cold-starting, never as dead — slow engine/runtime init "
+   "must not be reaped by the liveness plane mid-boot.")
+_D("autoscaler_drain_timeout_s", float, 15.0,
+   "Drain-before-reap bound: an idle node chosen for reap waits up to "
+   "this long for in-flight tasks to finish and node-held result "
+   "bytes to lease-transfer (object_offload to their owner + "
+   "object_transfer re-point of head fallback entries) before the "
+   "provider terminates it.")
+_D("serve_wake_timeout_s", float, 30.0,
+   "Scale-to-zero wake bound: a request arriving at a deployment with "
+   "zero replicas queues while the controller scales it back up, and "
+   "fails typed only past this many seconds.")

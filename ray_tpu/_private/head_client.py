@@ -555,6 +555,16 @@ class HeadClient:
     def node_list(self):
         return [dict(n) for n in self._request(("node_list",))]
 
+    def node_drain(self, target_client: str,
+                   timeout: float = 15.0) -> dict:
+        """Drain-before-reap handshake (autoscaler -> head -> node):
+        the node cordons itself (new pushes refuse typed and reroute),
+        finishes in-flight tasks, and lease-transfers node-held result
+        bytes to their owners. Returns the node's drain report
+        ({"transferred": n, "untransferred": n, "refused": n})."""
+        return dict(self._request(
+            ("node_drain", target_client, float(timeout))) or {})
+
     def task_push(self, target_client: str, payload: bytes):
         return self._request(("task_push", target_client, payload))
 
@@ -629,11 +639,13 @@ class HeadClient:
             if msg[0] != "req":
                 continue
             rid, event = msg[1], msg[2:]
-            if event and event[0] == "actor_call":
+            if event and event[0] in ("actor_call", "node_drain"):
                 # Relayed actor calls wait unbounded for method completion
                 # (long-running methods are legitimate) — they get their
                 # OWN thread so they can never starve the 4-thread pool
-                # that serves object reads / task pushes / pubsub.
+                # that serves object reads / task pushes / pubsub. Node
+                # drains (bounded but long: in-flight wait + lease
+                # transfer) ride the same dedicated-thread path.
                 threading.Thread(
                     target=self._serve_event, args=(rid, event),
                     daemon=True, name="ray_tpu_head_actor_call").start()

@@ -828,6 +828,16 @@ class HeadService:
                 _, target_client, payload = msg
                 return self._relay(target_client, ("task_push", payload),
                                    timeout=60.0)
+            if kind == "node_drain":
+                # Drain-before-reap (autoscaler -> node): the target
+                # cordons itself, finishes in-flight work, and
+                # lease-transfers held bytes before its reaper
+                # terminates the process. Bounded: a wedged node must
+                # not pin the autoscaler's monitor.
+                _, target_client, timeout_s = msg
+                return self._relay(
+                    target_client, ("node_drain", float(timeout_s)),
+                    timeout=float(timeout_s) + 10.0)
             if kind == "task_done":
                 # Node -> head -> submitting driver (the RELAY fallback
                 # — steady-state completions go node->driver direct and

@@ -123,6 +123,18 @@ class NodeDaemon:
         # head relay above stays as the NAT/dial-failure fallback.
         self.head._object_server.handlers["task_push"] = \
             self._on_direct_task_push
+        # Drain-before-reap (autoscaler -> head relay -> here, with a
+        # direct-plane twin): cordon, finish in-flight work, lease-
+        # transfer held result bytes, then report back so the reaper
+        # may terminate this process without stranding a borrowed ref.
+        self.head.handlers["node_drain"] = self._on_node_drain
+        self.head._object_server.handlers["node_drain"] = \
+            self._on_node_drain
+        # Function-cache pre-ship: a driver that sees this node join
+        # pushes its hot function bytes ahead of the first task, so the
+        # cold node's first fan-out wave skips the need_fn round trip.
+        self.head._object_server.handlers["fn_preship"] = \
+            self._on_fn_preship
         # Streaming-generator control plane: consumption acks resume a
         # backpressure-paused producer, cancels stop it between yields.
         # Direct messages from the consuming driver; the pub/sub topic
@@ -209,6 +221,20 @@ class NodeDaemon:
         # relay fallback had to announce to the head.
         self.direct_report_batches = 0
         self.announce_fallback_oids = 0
+        # Drain-before-reap state: once draining, every new push is
+        # refused typed ("draining") and the driver reroutes — a node
+        # chosen for reap must never accept work it will not report.
+        # _result_owner maps node-held result oids -> (driver_id,
+        # driver_addr) so drain can lease-transfer each object's bytes
+        # back to its owner (bounded FIFO like _seen_tasks; evicted
+        # entries fall back to lineage on reap, same as a crash).
+        self._draining = False
+        self._result_owner: Dict[bytes, tuple] = {}
+        self._result_owner_order: "_deque" = _deque()
+        self.drain_refusals = 0
+        self.drain_transferred = 0
+        self.drain_untransferred = 0
+        self.fn_preshipped = 0  # functions registered ahead of any push
 
     # -------------------------------------------------------- function cache
     def _register_fn(self, fn_bytes: bytes) -> bytes:
@@ -322,6 +348,10 @@ class NodeDaemon:
             "available": self.worker.resource_pool.available(),
             "actors": hosted,  # borrowed handles are not load
             "unmet": router.unmet_shapes() if router is not None else [],
+            # Cordon marker: routers skip draining nodes for NEW
+            # placements (the typed push refusal covers the heartbeat
+            # staleness window).
+            "draining": self._draining,
         }
 
     # ----------------------------------------------------------- task serve
@@ -336,6 +366,14 @@ class NodeDaemon:
         cache is settled synchronously HERE — before the ``accepted``
         reply — so a driver that marks a digest as shipped can never
         race a not-yet-registered cache entry."""
+        if self._draining:
+            # Reap race: this node was chosen for reap while the push
+            # was in flight. Refuse-and-reroute (typed, counted) — an
+            # accepted task would execute into a terminating process
+            # and its completion report would never land.
+            with self._seen_lock:
+                self.drain_refusals += 1
+            return "draining"
         payload = pickle.loads(bytes(payload_bytes))
         fn_bytes = payload.get("fn")
         digest = payload.get("fn_digest")
@@ -542,6 +580,20 @@ class NodeDaemon:
         sizes, errs, inline = completion_fields(
             self.worker.store, return_ids, payload.get("name", "task"))
         oid_bins = [o.binary() for o in return_ids]
+        # Drain bookkeeping: results whose BYTES stay node-held (too
+        # big to inline) are exactly the refs a reap could strand —
+        # remember their owner so drain can offload them back.
+        addr0 = payload.get("driver_addr")
+        if addr0:
+            with self._seen_lock:
+                for ob in sizes:
+                    if ob not in inline and ob not in self._result_owner:
+                        self._result_owner[ob] = (
+                            payload["driver_id"], tuple(addr0))
+                        self._result_owner_order.append(ob)
+                while len(self._result_owner_order) > 65536:
+                    self._result_owner.pop(
+                        self._result_owner_order.popleft(), None)
         done = pickle.dumps({
             "task_id": bytes(payload["task_id"]),
             "oid_bins": oid_bins,
@@ -578,6 +630,19 @@ class NodeDaemon:
         }, protocol=5)
         addr = payload.get("driver_addr")
         announce = oid.binary() if inline is None else None
+        if announce is not None and addr:
+            # Streamed items too big to inline are node-held borrowed
+            # bytes exactly like task returns: drain must be able to
+            # lease-transfer them, or reaping an idle producer node
+            # strands the consumer's not-yet-pulled tail items.
+            with self._seen_lock:
+                if announce not in self._result_owner:
+                    self._result_owner[announce] = (
+                        payload["driver_id"], tuple(addr))
+                    self._result_owner_order.append(announce)
+                while len(self._result_owner_order) > 65536:
+                    self._result_owner.pop(
+                        self._result_owner_order.popleft(), None)
         return (item, announce, tuple(addr) if addr else None,
                 payload["driver_id"])
 
@@ -705,6 +770,90 @@ class NodeDaemon:
                 except Exception as exc:  # driver gone: results stay
                     log.debug("completion relay to driver %s failed "
                               "(results stay local): %r", driver_id, exc)
+
+    # ----------------------------------------------------------------- drain
+    def _on_fn_preship(self, msg: tuple):
+        """Function-cache pre-ship on node join: register pushed
+        function bytes ahead of any task so a cold node's first wave
+        skips the need_fn round trip. Idempotent (digest-keyed)."""
+        count = 0
+        for fnb in msg[1]:
+            self._register_fn(bytes(fnb))
+            count += 1
+        with self._seen_lock:
+            self.fn_preshipped += count
+        return count
+
+    def _on_node_drain(self, msg: tuple):
+        """Drain-before-reap: cordon this node (new pushes refuse
+        typed), wait out in-flight tasks and pending completion
+        reports, then lease-transfer node-held result bytes to their
+        owning drivers (``object_offload`` over the direct plane) and
+        re-point the head's fallback directory entries at the new
+        holder (``object_transfer`` — the PR 10 lease-handoff path).
+        Returns the drain report; the reaper terminates the process
+        only after this reply, so a drained reap can never strand a
+        borrowed ref. Bounded by the caller-supplied timeout — a
+        wedged drain degrades to crash semantics (lineage replay)."""
+        timeout_s = float(msg[1]) if len(msg) > 1 else 15.0
+        self._draining = True
+        deadline = time.monotonic() + max(timeout_s, 0.1)
+        # 1. In-flight work finishes: queued + running tasks, then the
+        # reporter queue flushes (a completed task whose report never
+        # left would strand its locations driver-side as "pending").
+        while time.monotonic() < deadline:
+            with self._report_cv:
+                reports_pending = bool(self._report_q)
+            if self.worker.scheduler.backlog_size() == 0 \
+                    and not reports_pending:
+                break
+            time.sleep(0.05)
+        # 2. Lease-transfer node-held result bytes, grouped per owner.
+        with self._seen_lock:
+            owned = list(self._result_owner.items())
+        by_owner: Dict[tuple, list] = {}
+        store = self.worker.store
+        for ob, owner in owned:
+            oid = ObjectID(bytes(ob))
+            if not store.is_ready(oid) or store.peek_error(oid) \
+                    is not None:
+                continue
+            try:
+                raw = store.get(oid, timeout=5.0).to_bytes()
+            except Exception:  # noqa: BLE001 — racing eviction
+                continue
+            by_owner.setdefault(owner, []).append((ob, raw))
+        transferred: list = []  # (oid_bin, holder) for the head re-point
+        for (drv, addr), entries in by_owner.items():
+            # Chunked flights bound the frame size; the driver stores
+            # the bytes locally and re-points its owner table.
+            # Accounting is PER CHUNK: a partially-successful owner
+            # transfer counts exactly what moved (transferred +
+            # untransferred always sums to the held set).
+            for i in range(0, len(entries), 64):
+                chunk = entries[i:i + 64]
+                try:
+                    self.head._peers.call(
+                        tuple(addr), ("object_offload", chunk))
+                    transferred.extend((ob, drv) for ob, _ in chunk)
+                    self.drain_transferred += len(chunk)
+                except Exception as exc:  # noqa: BLE001 — owner gone:
+                    self.drain_untransferred += len(chunk)
+                    log.warning("drain offload of %d object(s) to "
+                                "driver %s failed (lineage will "
+                                "replay): %r", len(chunk), drv, exc)
+        # 3. Re-point head FALLBACK directory entries naming this node
+        # as holder: the owning driver holds the bytes now, so relayed
+        # borrowers keep resolving after this process exits.
+        if transferred:
+            try:
+                self.head.object_transfer_many(transferred)
+            except Exception as exc:  # noqa: BLE001 — head gone: the
+                log.debug("drain head re-point failed (owner-direct "
+                          "resolution still covers these): %r", exc)
+        return {"transferred": self.drain_transferred,
+                "untransferred": self.drain_untransferred,
+                "refused": self.drain_refusals}
 
     # -------------------------------------------------------------- lifecycle
     def run_forever(self):

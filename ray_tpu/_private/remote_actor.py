@@ -366,13 +366,24 @@ class RemoteActorRuntime:
 
     # ------------------------------------------------------------- lifecycle
     def terminate(self, no_restart: bool = True):
-        if self.dead and no_restart:
-            return
         payload = pickle.dumps({
             "op": "kill",
             "actor_id": self.actor_id.binary(),
             "no_restart": bool(no_restart),
         }, protocol=5)
+        if self.dead and no_restart:
+            # Already marked dead DRIVER-side — but death marking is a
+            # liveness inference (node briefly absent from membership),
+            # not ground truth. Still push the node-side kill: a
+            # false-positive death would otherwise orphan the hosted
+            # actor on a live daemon forever (it counts as load, so an
+            # autoscaler never reaps the node). Idempotent: a truly
+            # dead node/actor ignores it.
+            try:
+                self._dispatch.submit(self._kill_quietly, payload)
+            except RuntimeError:  # dispatch already shut down
+                pass
+            return
         if no_restart:
             err = ActorDiedError(self.actor_id, "killed via ray_tpu.kill()")
             with self._lock:

@@ -70,6 +70,7 @@ from ray_tpu._private.scheduler import TaskSpec, _collect_refs
 log = get_logger(__name__)
 from ray_tpu.exceptions import (
     GetTimeoutError,
+    NodeDrainingError,
     RayTaskError,
     WorkerCrashedError,
 )
@@ -77,6 +78,7 @@ from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
 _NODES_TTL_S = 0.5
 _MAX_PUSH_ATTEMPTS = 3
+_DRAINING_TTL_S = 60.0  # push-refusal cordon memory (reap follows soon)
 
 
 class _DepNotReady(Exception):
@@ -101,6 +103,13 @@ class RemoteRouter:
         # p2p pull), exactly like task_done; the pub/sub topic
         # ``stream|<client>`` is the head-relayed fallback.
         self.head._object_server.handlers["item_done"] = self._on_item_done
+        # Drain-before-reap receiving side: a draining node lease-
+        # transfers the result bytes it holds for THIS owner in
+        # object_offload flights — the bytes land in the local store
+        # and the owner table re-points at ourselves, so borrowers keep
+        # resolving after the node exits.
+        self.head._object_server.handlers["object_offload"] = \
+            self._on_object_offload
         self.lineage: Dict[TaskID, TaskSpec] = {}
         self._done: Dict[TaskID, threading.Event] = {}
         self._done_cbs: Dict[TaskID, List[Callable[[], None]]] = {}
@@ -151,6 +160,20 @@ class RemoteRouter:
             self.head.status_fn = self._status
         self._recovering: set = set()
         self._prefetching: set = set()
+        # Nodes that refused a push with "draining" (reap cordon):
+        # skipped by _choose_node until the TTL lapses — the membership
+        # heartbeat's draining marker takes over once it propagates.
+        self._draining_nodes: Dict[str, float] = {}  # cid -> marked at
+        self.drain_reroutes = 0    # pushes refused by a draining node
+        self.offloaded_objects = 0  # drain lease-transfers received
+        # Function-cache pre-ship: the last few distinct functions this
+        # driver shipped anywhere (digest -> bytes, tiny LRU). A newly
+        # joined node gets them pushed ahead of its first task, so the
+        # cold-start fan-out wave skips the need_fn round trip.
+        from collections import OrderedDict as _OrderedDict
+
+        self._fn_recent: "_OrderedDict[bytes, bytes]" = _OrderedDict()
+        self.fn_preship_sent = 0
         # Streaming generator bookkeeping: tasks whose consumption acks
         # this driver must propagate (consume-listener installed once per
         # task), the coalesced ack watermarks awaiting a wire flush, and
@@ -210,6 +233,14 @@ class RemoteRouter:
         self._watcher = threading.Thread(
             target=self._watch_loop, daemon=True, name="ray_tpu_router_watch")
         self._watcher.start()
+        try:
+            # Membership events drive the function-cache pre-ship (a
+            # joining node gets this driver's hot functions before its
+            # first task) — best-effort; need_fn stays the safety net.
+            self.head.subscribe("ray_tpu:node_events",
+                                self._on_node_event)
+        except Exception:  # noqa: BLE001 — headless/standalone runtime
+            pass
 
     # ------------------------------------------------------------- routing
     def nodes(self, refresh: bool = False) -> List[dict]:
@@ -258,10 +289,32 @@ class RemoteRouter:
                 loc[owner] = loc.get(owner, 0) + size
         return loc
 
+    def _is_draining(self, n: dict) -> bool:
+        """Cordoned for reap: the heartbeat's draining marker, or a
+        recent typed push refusal from the node itself (which beats the
+        heartbeat by up to one period)."""
+        if (n.get("status") or {}).get("draining"):
+            return True
+        if not self._draining_nodes:
+            # Lock-free steady-state fast path: nothing has ever
+            # drained, so don't pay lock contention per candidate per
+            # task. The benign race (a refusal landing right now) is
+            # already covered by the typed push refusal itself.
+            return False
+        with self._lock:
+            ts = self._draining_nodes.get(n["client_id"])
+            if ts is None:
+                return False
+            if time.monotonic() - ts > _DRAINING_TTL_S:
+                self._draining_nodes.pop(n["client_id"], None)
+                return False
+        return True
+
     def _choose_node(self, spec: TaskSpec,
                      exclude: tuple = ()) -> Optional[dict]:
         nodes = [n for n in self.nodes()
-                 if n.get("alive") and n["client_id"] not in exclude]
+                 if n.get("alive") and n["client_id"] not in exclude
+                 and not self._is_draining(n)]
         strat = spec.scheduling_strategy
         if isinstance(strat, NodeAffinitySchedulingStrategy):
             for n in nodes:
@@ -357,7 +410,11 @@ class RemoteRouter:
         """
         demand = self.actor_demand(opts)
         strat = opts.get("scheduling_strategy")
-        nodes = [n for n in self.nodes(refresh=True) if n.get("alive")]
+        # Draining nodes are cordoned for ACTORS too: placing onto a
+        # node mid-reap creates the actor into a terminating process
+        # (its creation either fails typed or strands node-side work).
+        nodes = [n for n in self.nodes(refresh=True)
+                 if n.get("alive") and not self._is_draining(n)]
         client_mode = getattr(self.worker, "client_mode", False)
         if isinstance(strat, NodeAffinitySchedulingStrategy):
             if strat.node_id == self.worker.node_id.hex() \
@@ -381,7 +438,9 @@ class RemoteRouter:
                 with self._lock:
                     self._unmet_hints.append((dict(demand),
                                               time.monotonic()))
-                raise ValueError(
+                from ray_tpu.exceptions import PlacementInfeasibleError
+
+                raise PlacementInfeasibleError(
                     f"actor resource demand {demand} is infeasible: no "
                     f"local capacity and no feasible cluster node")
             return self._record_placement(
@@ -713,6 +772,17 @@ class RemoteRouter:
                     st = self.worker.streams.get(spec.task_id)
                     if st is not None and st.consumed > 0:
                         self._send_stream_ack(spec.task_id, st.consumed)
+            elif rep == "draining":
+                # Reap race: the node was chosen for reap while this
+                # push was in flight. Typed refuse-and-reroute — cordon
+                # the node locally and re-dispatch elsewhere (counted;
+                # never a task failure).
+                with self._lock:
+                    self._dec_inflight_locked(cid)
+                    self._draining_nodes[cid] = time.monotonic()
+                    self.drain_reroutes += 1
+                self._retry_or_fail(spec, tried + (cid,),
+                                    NodeDrainingError(cid))
             elif rep == "need_fn" and reship_ok:
                 # The node lost (or never saw) this digest: rebuild with
                 # the function bytes forced in and push once more.
@@ -798,6 +868,14 @@ class RemoteRouter:
             self._fn_wire_cache[fn] = cached
         except TypeError:  # unhashable/unweakrefable callable
             pass
+        with self._lock:
+            # Hot-function LRU feeding the node-join pre-ship (small,
+            # bytes-bounded by entry count — fat closures are capped by
+            # the node-side cache anyway).
+            self._fn_recent[cached[0]] = fnb
+            self._fn_recent.move_to_end(cached[0])
+            while len(self._fn_recent) > 8:
+                self._fn_recent.popitem(last=False)
         return cached
 
     def _build_payload(self, spec: TaskSpec, cid: str,
@@ -1104,6 +1182,78 @@ class RemoteRouter:
             for ctid in children:
                 self._fail_downstream(ctid, first_exc)
         return None
+
+    # --------------------------------------------------------------- drain
+    def _on_object_offload(self, msg: tuple):
+        """A draining node lease-transfers result bytes it holds for
+        this owner: store them locally and re-point the owner table at
+        ourselves — borrowers' ``owner_locate`` then resolves against
+        OUR store/server, and reap cannot strand the refs."""
+        from ray_tpu._private.serialization import SerializedObject
+
+        stored = 0
+        for ob, raw in msg[1]:
+            oid = ObjectID(bytes(ob))
+            if not self.worker.store.is_ready(oid):
+                self.worker.store.put(
+                    oid, SerializedObject.from_bytes(bytes(raw)))
+            with self._lock:
+                # Local bytes win every later lookup (OwnerDirectory
+                # checks the store first); drop the stale holder entry.
+                self._oid_owner.pop(bytes(ob), None)
+                self.offloaded_objects += 1
+            stored += 1
+        self.owner_directory.publish_many(
+            [bytes(ob) for ob, _ in msg[1]])
+        return stored
+
+    def _on_node_event(self, payload):
+        """Membership event (head pub/sub): a newly joined node gets
+        this driver's hot function bytes pushed ahead of its first
+        task (cold-start attack: the first fan-out wave on a fresh
+        autoscaled node skips the need_fn round trip)."""
+        try:
+            if not isinstance(payload, dict) or \
+                    payload.get("event") != "node_added":
+                return
+            cid = payload.get("client_id")
+            with self._lock:
+                fn_bytes = list(self._fn_recent.values())
+            if not fn_bytes or cid is None:
+                return
+            self._prefetch_pool.submit(self._preship_fns, cid, fn_bytes)
+        except Exception:  # noqa: BLE001 — keep the event thread alive
+            pass
+
+    def _preship_fns(self, cid: str, fn_bytes: list):
+        # The join event can beat the node's first heartbeat (which
+        # carries its direct-server address): wait it out briefly.
+        addr = None
+        for _ in range(20):
+            node = next((n for n in self.nodes(refresh=True)
+                         if n["client_id"] == cid), None)
+            addr = self._node_addr(node) if node else None
+            if addr is not None or self._stop.is_set():
+                break
+            time.sleep(0.25)
+        if addr is None:
+            return
+        try:
+            self.head._peers.call(addr, ("fn_preship", fn_bytes))
+            import hashlib
+
+            with self._lock:
+                self.fn_preship_sent += len(fn_bytes)
+                # Mark the digests shipped for this node: payload
+                # builds go digest-only on the first push (the whole
+                # point); the node's need_fn reply self-heals any
+                # divergence, same as every other stale mark.
+                shipped = self._fn_shipped.setdefault(cid, set())
+                for fnb in fn_bytes:
+                    shipped.add(hashlib.sha256(fnb).digest())
+        except Exception as exc:  # noqa: BLE001 — cold node not yet
+            log.debug("fn pre-ship to %s failed (need_fn covers it): "
+                      "%r", cid, exc)
 
     # ----------------------------------------------------------- streaming
     def _track_stream(self, spec: TaskSpec):

@@ -286,15 +286,26 @@ class LocalSubprocessProvider(NodeProvider):
     """Launches genuine ``node_daemon`` OS processes against a head —
     the FakeMultiNodeProvider analogue, except the nodes are real: they
     register with the head, lease tasks, host actors, and die by
-    SIGTERM (SURVEY §4 fake_multi_node; §2.7)."""
+    SIGTERM (SURVEY §4 fake_multi_node; §2.7).
+
+    Launch failures are TYPED: each attempt waits out the launching-
+    node grace window (``RAY_TPU_AUTOSCALER_LAUNCH_GRACE_S`` — a slow
+    cold start is not a dead node), failed attempts retry with jittered
+    exponential backoff (``RAY_TPU_AUTOSCALER_LAUNCH_RETRIES`` /
+    ``_BACKOFF_S``), and exhaustion raises ``NodeLaunchFailedError``
+    instead of surfacing as silent membership absence.
+    ``launch_attempts``/``launch_failures`` count every try (exposed
+    through ``util.state.autoscaler_summary``)."""
 
     def __init__(self, address: str, worker_mode: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None):
         self.address = address
         self.worker_mode = worker_mode
         self.env = env
+        self.launch_attempts = 0   # every provider launch try
+        self.launch_failures = 0   # tries that did not produce a node
 
-    def launch(self, node_type: "NodeTypeConfig"):
+    def _spawn(self, node_type: "NodeTypeConfig"):
         import json
         import os
         import subprocess
@@ -308,18 +319,72 @@ class LocalSubprocessProvider(NodeProvider):
         if self.worker_mode:
             cmd += ["--worker-mode", self.worker_mode]
         env = dict(self.env if self.env is not None else os.environ)
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
                                 env=env)
-        # The daemon prints "... joined <addr> as <client_id>" once it
-        # has registered — capture the client id so the autoscaler can
-        # match this handle to head membership.
-        line = proc.stdout.readline()
-        if "joined" not in line:
+
+    @staticmethod
+    def _read_join_line(proc, grace_s: float) -> Optional[str]:
+        """The daemon prints "... joined <addr> as <client_id>" once
+        registered. Bounded read: a cold start slower than the grace
+        window (or a daemon killed mid-boot — EOF) returns None instead
+        of pinning the autoscaler's monitor thread forever."""
+        out: list = []
+        done = threading.Event()
+
+        def _read():
+            try:
+                out.append(proc.stdout.readline())
+            except Exception:  # noqa: BLE001 — pipe torn by a kill
+                out.append("")
+            done.set()
+
+        t = threading.Thread(target=_read, daemon=True,
+                             name="ray_tpu_launch_read")
+        t.start()
+        if not done.wait(max(grace_s, 0.1)):
+            return None
+        line = out[0] if out else ""
+        return line if "joined" in line else None
+
+    def launch(self, node_type: "NodeTypeConfig"):
+        import random
+
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu.exceptions import NodeLaunchFailedError
+
+        attempts = max(1, int(GlobalConfig.autoscaler_launch_retries))
+        backoff = float(GlobalConfig.autoscaler_launch_backoff_s)
+        grace = float(GlobalConfig.autoscaler_launch_grace_s)
+        last = "no attempt ran"
+        for attempt in range(attempts):
+            self.launch_attempts += 1
+            proc = self._spawn(node_type)
+            line = self._read_join_line(proc, grace)
+            if line is not None:
+                client_id = line.strip().rsplit(" ", 1)[-1]
+                return {"proc": proc, "client_id": client_id}
+            self.launch_failures += 1
+            rc = proc.poll()
+            last = (f"daemon exited rc={rc} before joining" if rc
+                    is not None else
+                    f"no join within the {grace:.0f}s launch grace")
             proc.kill()
-            raise RuntimeError(
-                f"node daemon failed to join: {line!r}")
-        client_id = line.strip().rsplit(" ", 1)[-1]
-        return {"proc": proc, "client_id": client_id}
+            try:
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001 — unreaped zombie at worst
+                pass
+            log.warning("node launch attempt %d/%d for type %r failed "
+                        "(%s); %s", attempt + 1, attempts,
+                        node_type.name, last,
+                        "retrying with backoff"
+                        if attempt + 1 < attempts else "giving up")
+            if attempt + 1 < attempts:
+                time.sleep(backoff * (2 ** attempt)
+                           * (0.5 + random.random()))
+        raise NodeLaunchFailedError(
+            node_type.name, attempts,
+            f"node type {node_type.name!r} failed to launch after "
+            f"{attempts} attempt(s); last error: {last}")
 
     def terminate(self, handle) -> None:
         proc = handle["proc"]
@@ -340,6 +405,15 @@ class _Managed:
     handle: Any
     client_id: str
     idle_since: Optional[float] = None
+    launched_at: float = 0.0  # join time (monotonic): reap-grace anchor
+    was_busy: bool = False    # observed doing work at least once
+
+
+# Live ClusterAutoscaler registry (weak): util.state.autoscaler_summary
+# reads launch/drain counters and cold-start events off it.
+import weakref
+
+_AUTOSCALERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class ClusterAutoscaler:
@@ -370,10 +444,19 @@ class ClusterAutoscaler:
         self._stop = threading.Event()
         self.launched: List[str] = []
         self.terminated: List[str] = []
+        # Cold-start SLO surface: one record per scale-up event —
+        # {type, launch_started, joined, client_id} on the shared
+        # CLOCK_MONOTONIC domain, so a replica's first-token timestamp
+        # (same machine) subtracts directly.
+        self.scale_events: List[Dict[str, Any]] = []
+        self.launch_errors = 0     # typed NodeLaunchFailedError count
+        self.drained_nodes = 0     # reaps that completed a drain
+        self.drain_transferred_objects = 0
         import uuid
 
         self.head = HeadClient(
             address, client_id=f"autoscaler-{uuid.uuid4().hex[:8]}")
+        _AUTOSCALERS.add(self)
         for t in node_types:
             for _ in range(t.min_workers):
                 self._launch(t)
@@ -394,20 +477,70 @@ class ClusterAutoscaler:
         return self._counts().get(name, 0)
 
     def _launch(self, t: NodeTypeConfig) -> bool:
+        from ray_tpu.exceptions import NodeLaunchFailedError
+
         if self._counts().get(t.name, 0) >= t.max_workers:
             return False
+        t_start = time.monotonic()
         try:
             handle = self.provider.launch(t)
+        except NodeLaunchFailedError as exc:
+            # Typed exhaustion (the provider already retried with
+            # backoff): surfaced loudly, next monitor tick re-decides.
+            with self._lock:
+                self.launch_errors += 1
+                self._record_event({
+                    "type": t.name, "launch_started": t_start,
+                    "joined": None, "client_id": None,
+                    "error": repr(exc)})
+            log.warning("node launch for type %r failed typed: %s",
+                        t.name, exc)
+            return False
         except Exception:  # noqa: BLE001 — provider failure: retry later
             return False
+        now = time.monotonic()
         client_id = handle.get("client_id", "") \
             if isinstance(handle, dict) else ""
         with self._lock:
-            self._managed.append(_Managed(t.name, handle, client_id))
+            self._managed.append(_Managed(t.name, handle, client_id,
+                                          launched_at=now))
             self.launched.append(t.name)
+            self._record_event({
+                "type": t.name, "launch_started": t_start,
+                "joined": now, "client_id": client_id})
         return True
 
-    def _terminate(self, m: _Managed):
+    def _record_event(self, event: Dict[str, Any]) -> None:
+        """Bounded scale-event history (observability, not a ledger) —
+        caller holds self._lock."""
+        self.scale_events.append(event)
+        if len(self.scale_events) > 256:
+            del self.scale_events[:len(self.scale_events) - 256]
+
+    def _terminate(self, m: _Managed, drain: bool = False):
+        """Reap one managed node. With ``drain=True`` (the idle-reap
+        path) the node is first asked to DRAIN: it cordons itself
+        (refuse-and-reroute for racing pushes), finishes in-flight
+        tasks, and lease-transfers node-held result bytes to their
+        owners (``object_offload``) + re-points head fallback entries
+        (``object_transfer``) — so reaping can never strand a borrowed
+        ref. A drain that fails (node wedged/gone) falls through to a
+        plain terminate: crash semantics (lineage) still cover it."""
+        if drain and m.client_id:
+            from ray_tpu._private.config import GlobalConfig
+
+            timeout = float(GlobalConfig.autoscaler_drain_timeout_s)
+            try:
+                report = self.head.node_drain(m.client_id,
+                                              timeout=timeout)
+                with self._lock:
+                    self.drained_nodes += 1
+                    self.drain_transferred_objects += int(
+                        (report or {}).get("transferred", 0))
+            except Exception as exc:  # noqa: BLE001 — wedged node:
+                log.warning("drain of node %s failed (%r); reaping "
+                            "undrained — lineage covers its refs",
+                            m.client_id, exc)
         try:
             self.provider.terminate(m.handle)
         except Exception:  # noqa: BLE001 — already gone
@@ -517,7 +650,15 @@ class ClusterAutoscaler:
                     if t.resources.get("CPU", 0.0) >= 1.0 \
                             and self._launch(t):
                         break
-        # 4. Scale down idle managed nodes past the timeout.
+        # 4. Scale down idle managed nodes past the timeout —
+        # drain-before-reap (cordon, finish in-flight, lease-transfer
+        # held bytes) so no borrowed ref strands. Launching-node grace:
+        # a node inside its launch grace window is never idle-reaped —
+        # a slow cold start (engine init, jit warmup) looks exactly
+        # like idleness to the load signals.
+        from ray_tpu._private.config import GlobalConfig
+
+        grace = float(GlobalConfig.autoscaler_launch_grace_s)
         now = time.monotonic()
         counts = self._counts()
         with self._lock:
@@ -534,6 +675,17 @@ class ClusterAutoscaler:
                     or (avail is not None and dict(avail) != dict(total)))
             if busy:
                 m.idle_since = None
+                m.was_busy = True
+                continue
+            if not m.was_busy and now - m.launched_at < grace \
+                    and shapes:
+                # Launching-node grace: while unmet demand still exists,
+                # a node never yet seen doing work looks exactly like an
+                # idle node although its payload (replica placement,
+                # engine init) is still in flight — reaping it would
+                # thrash launch/reap cycles against the very demand it
+                # was launched for. Once it has been busy — or demand
+                # drained — idleness is idleness.
                 continue
             if m.idle_since is None:
                 m.idle_since = now
@@ -542,8 +694,34 @@ class ClusterAutoscaler:
                 continue
             t = self.node_types[m.type_name]
             if counts.get(m.type_name, 0) > t.min_workers:
-                self._terminate(m)
+                self._terminate(m, drain=True)
                 counts[m.type_name] = counts.get(m.type_name, 0) - 1
+
+    def summary(self) -> Dict[str, Any]:
+        """Operational counters for ``util.state.autoscaler_summary``:
+        launch tries/failures (provider-level), typed launch errors,
+        drain outcomes, and every scale-up event with its join latency
+        (the cold-start SLO's node-plane half)."""
+        with self._lock:
+            events = [dict(e) for e in self.scale_events]
+            out = {
+                "managed_nodes": len(self._managed),
+                "launched": list(self.launched),
+                "terminated": list(self.terminated),
+                "launch_errors": self.launch_errors,
+                "drained_nodes": self.drained_nodes,
+                "drain_transferred_objects":
+                    self.drain_transferred_objects,
+            }
+        out["launch_attempts"] = getattr(
+            self.provider, "launch_attempts", 0)
+        out["launch_failures"] = getattr(
+            self.provider, "launch_failures", 0)
+        for e in events:
+            if e.get("joined") is not None:
+                e["join_latency_s"] = e["joined"] - e["launch_started"]
+        out["scale_events"] = events
+        return out
 
     def shutdown(self, terminate_nodes: bool = True):
         self._stop.set()
@@ -554,3 +732,8 @@ class ClusterAutoscaler:
             for m in managed:
                 self._terminate(m)
         self.head.close()
+
+
+def live_autoscalers() -> List["ClusterAutoscaler"]:
+    """ClusterAutoscalers alive in this process (state-API feed)."""
+    return list(_AUTOSCALERS)

@@ -15,6 +15,9 @@ Endpoints:
 - ``GET /api/chaos``    chaos + overload panel: injected wire-fault
   counters per site, NodeKiller kill log, and load-shedding /
   priority-admission stats from serve deployments and LLM engines
+- ``GET /api/elastic``  elasticity panel: autoscaler launch/drain
+  counters, scale-up events with join latency, serve deployment
+  scale/wake records (the cold-start SLO observables)
 - ``GET /api/head``     ownership-directory panel: the head's per-kind
   steady-state RPC counts + FT-log appends (the O(membership)-not-
   O(objects) flatness observable) and this runtime's owner/resolver
@@ -211,6 +214,12 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util.state import ownership_summary
 
                 payload = json.dumps(ownership_summary(),
+                                     default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/elastic"):
+                from ray_tpu.util.state import autoscaler_summary
+
+                payload = json.dumps(autoscaler_summary(),
                                      default=str).encode()
                 ctype = "application/json"
             else:

@@ -48,6 +48,12 @@ ALLOWED_FALLBACK_SITES: Set[Tuple[str, str, str]] = {
     # pre-ownership announce-everything path.
     ("ray_tpu/_private/node_daemon.py", "NodeDaemon._report_loop",
      "object_announce_many"),
+    # Node daemon drain-before-reap: after offloading node-held result
+    # bytes to their owning drivers, the head's FALLBACK directory
+    # entries naming this (exiting) node as holder re-point at the new
+    # holder — the same lease-handoff RPC the router's shutdown uses.
+    ("ray_tpu/_private/node_daemon.py", "NodeDaemon._on_node_drain",
+     "object_transfer_many"),
     # Consumer-side resolver: the head IS the fallback directory when
     # the owner is unreachable/ignorant, and the relay-from-named-holder
     # data path for pullers that cannot dial the holder.

@@ -168,6 +168,42 @@ class RequestSheddedError(RayTpuError):
                        f"{retry_after_s:.1f}s")
 
 
+class PlacementInfeasibleError(RayTpuError, ValueError):
+    """No local capacity and no feasible cluster node for a resource
+    demand RIGHT NOW — a capacity condition, not a bug: autoscalers
+    read the parked shape and launch for it, and placement retries.
+    Subclasses ValueError for pre-existing callers that matched the
+    untyped raise."""
+
+
+class NodeLaunchFailedError(RayTpuError):
+    """The autoscaler's provider could not bring a node up within its
+    bounded, jittered retry budget — a typed infrastructure failure,
+    not silent membership absence. ``attempts`` is how many launches
+    were tried; ``node_type`` names the shape that failed."""
+
+    def __init__(self, node_type: str = "", attempts: int = 0,
+                 message: str = ""):
+        self.node_type = node_type
+        self.attempts = attempts
+        super().__init__(
+            message or f"node type {node_type!r} failed to launch after "
+                       f"{attempts} attempt(s)")
+
+
+class NodeDrainingError(RayTpuError):
+    """A task push landed on a node already chosen for reap: the node
+    refused it (drain-before-reap cordon) instead of accepting work it
+    would never report. Routers reroute on this — it is a routing
+    race, not a task failure."""
+
+    def __init__(self, node_client: str = ""):
+        self.node_client = node_client
+        super().__init__(
+            f"node {node_client!r} is draining for reap and refuses "
+            f"new work (rerouted)")
+
+
 class ChannelError(RayTpuError):
     """Compiled-graph channel failure (closed, timeout, version skew)."""
 

@@ -48,8 +48,27 @@ class LLMServer:
     """
 
     def __init__(self, engine_config: Optional[EngineConfig] = None,
-                 params: Optional[dict] = None):
+                 params: Optional[dict] = None,
+                 warm_prefix: Optional[list] = None):
+        import time as _time
+
+        self.init_started_monotonic = _time.monotonic()
+        self.first_token_monotonic: Optional[float] = None
+        self.warmed_prefix_tokens = 0
         self.engine = InferenceEngine(engine_config, params=params)
+        if warm_prefix:
+            # Prefix-cache warming (cold-start attack): prefill the
+            # shared prompt ONCE at replica start, so it registers as
+            # COW shared blocks before the first request — the first
+            # same-prefix request computes only its unique tail, and
+            # the controller's next prefix_digest poll advertises the
+            # warmed chain to the router (requests route here WITH a
+            # cache hit from token one).
+            tokens = [int(t) for t in warm_prefix]
+            for _ in self.engine.generate(tokens, max_new_tokens=1):
+                pass
+            self.warmed_prefix_tokens = len(tokens)
+        self.ready_monotonic = _time.monotonic()
 
     def __call__(self, request: Union[Dict[str, Any], list]
                  ) -> Iterator[int]:
@@ -62,14 +81,30 @@ class LLMServer:
             prompt, kwargs = request, {}
         # A cancelled stream raises GeneratorExit through here; the
         # engine generator's finally-cancel frees the KV blocks.
-        yield from self.engine.generate([int(t) for t in prompt], **kwargs)
+        for tok in self.engine.generate([int(t) for t in prompt],
+                                        **kwargs):
+            if self.first_token_monotonic is None:
+                # Cold-start SLO anchor: the first REAL token this
+                # replica served, on the machine-shared monotonic
+                # clock — pairs with the autoscaler's launch_started.
+                import time as _time
+
+                self.first_token_monotonic = _time.monotonic()
+            yield tok
 
     # ------------------------------------------------- replica telemetry
     def queue_depth(self) -> int:
         return self.engine.queue_depth()
 
     def stats(self) -> Dict[str, Any]:
-        return self.engine.stats()
+        out = dict(self.engine.stats())
+        out.update({
+            "init_started_monotonic": self.init_started_monotonic,
+            "ready_monotonic": self.ready_monotonic,
+            "first_token_monotonic": self.first_token_monotonic,
+            "warmed_prefix_tokens": self.warmed_prefix_tokens,
+        })
+        return out
 
     def prefix_digest(self) -> Dict[str, Any]:
         """Compact cached-prefix report: the chain digests of every
@@ -91,7 +126,9 @@ def build_llm_app(engine_config: Optional[EngineConfig] = None, *,
                   name: str = "llm", num_replicas: int = 1,
                   autoscaling_config: Optional[dict] = None,
                   max_ongoing_requests: Optional[int] = None,
-                  params: Optional[dict] = None):
+                  params: Optional[dict] = None,
+                  warm_prefix: Optional[list] = None,
+                  ray_actor_options: Optional[dict] = None):
     """Build a Serve Application serving ``engine_config``.
 
     Every replica constructs its own engine; with ``params=None`` the
@@ -105,11 +142,18 @@ def build_llm_app(engine_config: Optional[EngineConfig] = None, *,
     deployment (priority admission: lower classes shed first with a
     typed ``RequestSheddedError`` / HTTP 503 + Retry-After); request
     ``priority`` rides the request dict.
+
+    ``warm_prefix`` (token list — typically the shared system prompt)
+    is prefilled by every NEW replica at construction, so an
+    autoscaled-up or scale-to-zero-woken replica serves its first
+    same-prefix request with the prefill already cached (cold-start
+    SLO attack; ``stats()['warmed_prefix_tokens']`` confirms it).
     """
     from ray_tpu import serve
 
     dep = serve.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
         autoscaling_config=autoscaling_config,
-        max_ongoing_requests=max_ongoing_requests)
-    return dep.bind(engine_config, params)
+        max_ongoing_requests=max_ongoing_requests,
+        ray_actor_options=ray_actor_options)
+    return dep.bind(engine_config, params, warm_prefix)

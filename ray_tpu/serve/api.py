@@ -32,6 +32,7 @@ class Deployment:
     def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
                  autoscaling_config: Optional[dict] = None,
                  max_ongoing_requests: Optional[int] = None,
+                 ray_actor_options: Optional[dict] = None,
                  **_opts):
         self._target = cls_or_fn
         self.name = name
@@ -42,12 +43,20 @@ class Deployment:
         # past their priority class's nested threshold are refused with
         # a typed RequestSheddedError (HTTP: 503 + Retry-After).
         self.max_ongoing_requests = max_ongoing_requests
+        # Per-replica actor options (reference: deployment
+        # ray_actor_options — num_cpus/resources). A replica with a
+        # real resource demand places like any actor: infeasible
+        # demand parks as an unmet shape in the driver's heartbeat, so
+        # a ClusterAutoscaler LAUNCHES a node for it — replica
+        # scale-up drives real node scale-up.
+        self.ray_actor_options = dict(ray_actor_options or {})
 
     def options(self, **opts) -> "Deployment":
         merged = dict(
             name=self.name, num_replicas=self.num_replicas,
             autoscaling_config=self.autoscaling_config,
-            max_ongoing_requests=self.max_ongoing_requests)
+            max_ongoing_requests=self.max_ongoing_requests,
+            ray_actor_options=self.ray_actor_options)
         merged.update(opts)
         return Deployment(self._target, **merged)
 
@@ -58,7 +67,8 @@ class Deployment:
 def deployment(_cls=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                autoscaling_config: Optional[dict] = None,
-               max_ongoing_requests: Optional[int] = None, **opts):
+               max_ongoing_requests: Optional[int] = None,
+               ray_actor_options: Optional[dict] = None, **opts):
     """@serve.deployment decorator for classes or functions."""
 
     def wrap(cls):
@@ -77,7 +87,8 @@ def deployment(_cls=None, *, name: Optional[str] = None,
             target, name or getattr(cls, "__name__", "deployment"),
             num_replicas=num_replicas,
             autoscaling_config=autoscaling_config,
-            max_ongoing_requests=max_ongoing_requests, **opts)
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options, **opts)
 
     return wrap(_cls) if _cls is not None else wrap
 
@@ -100,7 +111,8 @@ def _deploy_app(app: Application) -> DeploymentHandle:
         auto = AutoscalingConfig(**d.autoscaling_config)
     controller.deploy(d.name, d._target, args, kwargs,
                       num_replicas=d.num_replicas, autoscaling=auto,
-                      max_ongoing_requests=d.max_ongoing_requests)
+                      max_ongoing_requests=d.max_ongoing_requests,
+                      ray_actor_options=d.ray_actor_options)
     return DeploymentHandle(d.name, controller)
 
 

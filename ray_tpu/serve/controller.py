@@ -23,11 +23,29 @@ log = get_logger(__name__)
 
 @dataclass
 class AutoscalingConfig:
+    """``min_replicas=0`` enables SCALE-TO-ZERO: past the downscale
+    delay with no ongoing requests the deployment drops its last
+    replica; the next request WAKES it (queues while the controller
+    scales back up, bounded by ``RAY_TPU_SERVE_WAKE_TIMEOUT_S``)
+    instead of shedding."""
+
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+
+
+# Scale/wake event history is BOUNDED (observability, not a ledger): a
+# long-lived deployment flapping for days must not grow memory or make
+# every status() copy thousands of dicts.
+_SCALE_EVENTS_MAX = 256
+
+
+def _record_scale_event(events: List[dict], event: dict) -> None:
+    events.append(event)
+    if len(events) > _SCALE_EVENTS_MAX:
+        del events[:len(events) - _SCALE_EVENTS_MAX]
 
 
 @dataclass
@@ -39,12 +57,19 @@ class DeploymentInfo:
     num_replicas: int
     autoscaling: Optional[AutoscalingConfig]
     max_ongoing_requests: Optional[int] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     replicas: List[Any] = field(default_factory=list)
     replica_set: ReplicaSet = field(default_factory=ReplicaSet)
     status: str = "UPDATING"
     request_count: int = 0
     last_scale_change: float = 0.0
     last_prefix_poll: float = 0.0
+    # Elasticity observability: every target change (autoscale up/down,
+    # wake) as {"t_decision", "from", "to", "reason"} on the shared
+    # monotonic clock — the serve half of the cold-start SLO pairing.
+    scale_events: List[dict] = field(default_factory=list)
+    wake_events: int = 0
+    last_wake_latency_s: float = 0.0
 
 
 class ServeController:
@@ -55,6 +80,14 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, DeploymentInfo] = {}
         self._lock = threading.RLock()
+        # Reconcile passes are MUTUALLY EXCLUSIVE: deploy(), the
+        # background loop, lazy routing and the wake path all call
+        # _reconcile_once — two concurrent passes would each observe
+        # live < target and start duplicate replicas, orphaning the
+        # loser's actor on its node (leaked load the autoscaler can
+        # never drain). Separate from _lock: replica construction is
+        # slow (engine init) and must not block status()/routing.
+        self._reconcile_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True,
@@ -72,20 +105,35 @@ class ServeController:
     def deploy(self, name: str, cls: type, init_args, init_kwargs,
                num_replicas: int,
                autoscaling: Optional[AutoscalingConfig],
-               max_ongoing_requests: Optional[int] = None) -> None:
+               max_ongoing_requests: Optional[int] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None
+               ) -> None:
         with self._lock:
             old = self._deployments.get(name)
             info = DeploymentInfo(
                 name=name, cls=cls, init_args=init_args,
                 init_kwargs=init_kwargs, num_replicas=num_replicas,
                 autoscaling=autoscaling,
-                max_ongoing_requests=max_ongoing_requests)
+                max_ongoing_requests=max_ongoing_requests,
+                ray_actor_options=dict(ray_actor_options or {}))
             if old is not None:
                 info.replicas = old.replicas
                 info.replica_set = old.replica_set
             info.replica_set.configure_admission(max_ongoing_requests)
             self._deployments[name] = info
-        self._reconcile_once()
+        from ray_tpu.exceptions import PlacementInfeasibleError
+
+        try:
+            self._reconcile_once()
+        except PlacementInfeasibleError as exc:
+            # Infeasible TODAY is a capacity condition, not a bug:
+            # the ask parked as an unmet shape (autoscaler signal) and
+            # the reconcile loop retries. Anything else (a broken
+            # replica constructor) propagates to the deploy caller —
+            # it would otherwise crash-loop silently forever.
+            log.warning("initial reconcile for %r deferred (%r); the "
+                        "reconcile loop retries as capacity appears",
+                        name, exc)
 
     def delete(self, name: str) -> None:
         with self._lock:
@@ -147,19 +195,55 @@ class ServeController:
                               exc)
 
     def _reconcile_once(self):
+        with self._reconcile_lock:
+            self._reconcile_once_locked()
+
+    def _reconcile_once_locked(self):
         with self._lock:
             infos = list(self._deployments.values())
+        first_exc = None
         for info in infos:
-            target = info.num_replicas
-            # Replace dead replicas first (failure recovery).
-            live = [r for r in info.replicas if not r._runtime.dead]
+            try:
+                self._reconcile_deployment(info)
+            except Exception as exc:  # noqa: BLE001 — one deployment's
+                # infeasible placement must not starve the others'
+                # reconciles; re-raised (first) so deploy()/wake
+                # callers still observe it.
+                if first_exc is None:
+                    first_exc = exc
+        if first_exc is not None:
+            raise first_exc
+
+    def _reconcile_deployment(self, info: DeploymentInfo):
+        target = info.num_replicas
+        # Replace dead replicas first (failure recovery) — with a
+        # defensive kill: a replica marked dead by the liveness
+        # plane may actually be alive on its node (heartbeat
+        # hiccup), and silently dropping the handle would orphan
+        # the node-side actor (leaked load that pins the node
+        # against the autoscaler's idle reaper forever).
+        live = []
+        for r in info.replicas:
+            if r._runtime.dead:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001 — truly gone
+                    pass
+            else:
+                live.append(r)
+        try:
             while len(live) < target:
                 live.append(self._start_replica(info))
             while len(live) > target:
                 ray_tpu.kill(live.pop())
+            info.status = "HEALTHY"
+        finally:
+            # Commit whatever exists even when a start raised mid-pass
+            # (infeasible placement awaiting an autoscaled node): an
+            # already-started replica must be TRACKED — dropping it
+            # would orphan its actor as phantom node load.
             info.replicas = live
             info.replica_set.update(live)
-            info.status = "HEALTHY"
 
     def _start_replica(self, info: DeploymentInfo):
         user_cls = info.cls
@@ -280,9 +364,15 @@ class ServeController:
         # requests per replica) — required for @serve.batch to coalesce.
         # SPREAD placement: with a cluster attached, replicas land across
         # the node daemons (and the driver), so a deployment scales past
-        # one machine — a no-op standalone.
-        return Replica.options(max_concurrency=100,
-                               scheduling_strategy="SPREAD").remote()
+        # one machine — a no-op standalone. ray_actor_options
+        # (num_cpus/resources) make the replica a REAL resource demand:
+        # with no feasible node the placement raises (parking an unmet
+        # shape for the autoscaler) and the reconcile loop retries as
+        # nodes launch.
+        replica_opts = dict(max_concurrency=100,
+                            scheduling_strategy="SPREAD")
+        replica_opts.update(info.ray_actor_options)  # user keys win
+        return Replica.options(**replica_opts).remote()
 
     # ---------------------------------------------------------- autoscale
     def _autoscale(self):
@@ -300,13 +390,72 @@ class ServeController:
             if (ongoing > cfg.target_ongoing_requests
                     and info.num_replicas < cfg.max_replicas
                     and now - info.last_scale_change > cfg.upscale_delay_s):
+                _record_scale_event(info.scale_events, {
+                    "t_decision": now, "from": info.num_replicas,
+                    "to": info.num_replicas + 1, "reason": "load"})
                 info.num_replicas += 1
                 info.last_scale_change = now
             elif (ongoing < cfg.target_ongoing_requests / 2
                   and info.num_replicas > cfg.min_replicas
                   and now - info.last_scale_change > cfg.downscale_delay_s):
+                if info.num_replicas == 1 and sum(qlens) > 0:
+                    continue  # scale-to-zero never kills live streams
+                _record_scale_event(info.scale_events, {
+                    "t_decision": now, "from": info.num_replicas,
+                    "to": info.num_replicas - 1, "reason": "idle"})
                 info.num_replicas -= 1
                 info.last_scale_change = now
+
+    # ----------------------------------------------------------------- wake
+    def wake_and_wait(self, name: str) -> None:
+        """Scale-to-zero wake: a request hit a deployment with zero
+        replicas. Raise the target back to one (recorded as a wake
+        scale event) and QUEUE the caller until a replica is live —
+        bounded by ``RAY_TPU_SERVE_WAKE_TIMEOUT_S``, past which a typed
+        ``GetTimeoutError`` surfaces instead of an unbounded hang.
+        Concurrent callers share the same wake: only the first bumps
+        the target, everyone waits on the replica set."""
+        import time as _time
+
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu.exceptions import (
+            GetTimeoutError,
+            PlacementInfeasibleError,
+        )
+
+        t0 = time.monotonic()
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                raise KeyError(f"no deployment named {name!r}")
+            if info.num_replicas == 0:
+                info.wake_events += 1
+                _record_scale_event(info.scale_events, {
+                    "t_decision": t0, "from": 0, "to": 1,
+                    "reason": "wake"})
+                info.num_replicas = 1
+                info.last_scale_change = t0
+        deadline = t0 + float(GlobalConfig.serve_wake_timeout_s)
+        while time.monotonic() < deadline:
+            try:
+                self._reconcile_once()
+            except PlacementInfeasibleError as exc:  # capacity pending
+                log.debug("wake reconcile retry pending capacity: %r",
+                          exc)
+            with self._lock:
+                info = self._deployments.get(name)
+                size = info.replica_set.size() if info else 0
+            if info is None:
+                raise KeyError(f"no deployment named {name!r}")
+            if size > 0:
+                with self._lock:
+                    info.last_wake_latency_s = time.monotonic() - t0
+                return
+            _time.sleep(0.25)
+        raise GetTimeoutError(
+            f"deployment {name!r} did not wake from zero replicas "
+            f"within {GlobalConfig.serve_wake_timeout_s:.0f}s "
+            f"(RAY_TPU_SERVE_WAKE_TIMEOUT_S)")
 
     # ------------------------------------------------------------- queries
     def _replica_set(self, name: str) -> ReplicaSet:
@@ -314,9 +463,19 @@ class ServeController:
             info = self._deployments.get(name)
         if info is None:
             raise KeyError(f"no deployment named {name!r}")
-        # Lazily ensure replicas exist before first routing.
+        # Lazily ensure replicas exist before first routing. An
+        # infeasible placement (replica demand awaiting an autoscaled
+        # node) is NOT a routing error: choose() then raises the
+        # no-replica signal and the handle's wake/wait path queues the
+        # request until capacity appears.
         if info.replica_set.size() == 0:
-            self._reconcile_once()
+            from ray_tpu.exceptions import PlacementInfeasibleError
+
+            try:
+                self._reconcile_once()
+            except PlacementInfeasibleError as exc:  # capacity pending
+                log.debug("lazy reconcile for %r deferred: %r",
+                          name, exc)
         return info.replica_set
 
     def _record_request(self, name: str):
@@ -341,6 +500,10 @@ class ServeController:
                     "requests": info.request_count,
                     "queue_lengths": info.replica_set.queue_lengths(),
                     "admission": info.replica_set.admission_stats(),
+                    "scale_events": [dict(e)
+                                     for e in info.scale_events],
+                    "wake_events": info.wake_events,
+                    "last_wake_latency_s": info.last_wake_latency_s,
                 }
                 for name, info in self._deployments.items()
             }

@@ -198,6 +198,11 @@ class _KVStreamFallbackGenerator:
         w = global_worker()
         base = f"serve|stream|{self._stream_id}"
         deadline = time.monotonic() + 60.0
+        oref = None
+        try:
+            oref = self._inner._to_object_ref()
+        except Exception:  # noqa: BLE001 — no ref (test stub): poll only
+            pass
         while True:
             raw = w.kv_get(f"{base}|{self._seq}".encode())
             if raw is not None:
@@ -213,6 +218,18 @@ class _KVStreamFallbackGenerator:
             if end is not None and self._seq >= int(end):
                 self.close()
                 raise StopIteration
+            if oref is not None:
+                # Dead-producer fast path: a killed replica's in-flight
+                # call materializes a typed error into the result ref
+                # (ActorDiedError via the node-death watcher) — surface
+                # it NOW so the client retries in seconds, instead of
+                # burning the full stall bound per stream (a mid-kill
+                # episode otherwise serializes every open stream behind
+                # a 60 s poll timeout).
+                call_err = w.store.peek_error(oref.object_id)
+                if call_err is not None:
+                    self.close()
+                    raise call_err
             if time.monotonic() > deadline:
                 self.close()
                 raise TimeoutError("stream stalled for 60s")
@@ -311,8 +328,31 @@ class DeploymentHandle:
         # Priority admission: past the deployment's class threshold this
         # raises a typed RequestSheddedError before any replica is
         # touched — overload degrades by policy, not by timeout.
-        key, replica = rs.choose(prefix_tokens=prefix_tokens,
-                                 priority=self._priority)
+        try:
+            key, replica = rs.choose(prefix_tokens=prefix_tokens,
+                                     priority=self._priority)
+        except RuntimeError:
+            # Zero replicas: a scaled-to-zero deployment WAKES (the
+            # request queues while the controller scales back up —
+            # bounded) instead of failing; detached routers have no
+            # controller and keep the raise. Bounded re-wake: the
+            # woken replica can die between wake_and_wait returning
+            # and the re-choose (a kill landing mid-wake) — retry the
+            # wake instead of leaking the raw no-replica RuntimeError.
+            wake = getattr(self._controller, "wake_and_wait", None)
+            if wake is None:
+                raise
+            for attempt in range(3):
+                wake(self._name)
+                rs = self._controller._replica_set(self._name)
+                try:
+                    key, replica = rs.choose(
+                        prefix_tokens=prefix_tokens,
+                        priority=self._priority)
+                    break
+                except RuntimeError:
+                    if attempt == 2:
+                        raise
         # Chain: unwrap DeploymentResponses into ObjectRefs so downstream
         # deployments receive resolved values without blocking here.
         args = tuple(

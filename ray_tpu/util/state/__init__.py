@@ -259,6 +259,66 @@ def chaos_summary() -> Dict[str, Any]:
     return out
 
 
+def autoscaler_summary() -> Dict[str, Any]:
+    """Elasticity panel (`/api/elastic` role): every live
+    ClusterAutoscaler's launch/drain counters and scale-up events
+    (``launch_attempts``/``launch_failures`` are the provider-level
+    tries behind the typed ``NodeLaunchFailedError`` surface;
+    ``scale_events`` carry join latency — the node half of the
+    cold-start SLO), plus each serve deployment's scale/wake record
+    (the replica half). Safe in any process; empty sections when
+    nothing autoscales here."""
+    scalers = []
+    try:
+        import sys
+
+        live = (sys.modules["ray_tpu.autoscaler"].live_autoscalers
+                if "ray_tpu.autoscaler" in sys.modules else lambda: [])
+        for sc in live():
+            scalers.append(sc.summary())
+    except Exception:  # noqa: BLE001 — panel must not fail the API
+        pass
+    out: Dict[str, Any] = {
+        "autoscalers": scalers,
+        "launch_attempts": sum(s.get("launch_attempts", 0)
+                               for s in scalers),
+        "launch_failures": sum(s.get("launch_failures", 0)
+                               for s in scalers),
+        "launch_errors": sum(s.get("launch_errors", 0)
+                             for s in scalers),
+        "drained_nodes": sum(s.get("drained_nodes", 0)
+                             for s in scalers),
+        "drain_transferred_objects": sum(
+            s.get("drain_transferred_objects", 0) for s in scalers),
+    }
+    serve_scaling: Dict[str, Any] = {}
+    try:
+        from ray_tpu.serve import controller as _controller
+
+        ctl = _controller._controller
+        if ctl is not None:
+            for name, st in ctl.status().items():
+                serve_scaling[name] = {
+                    "replicas": st["replicas"],
+                    "target_replicas": st["target_replicas"],
+                    "scale_events": st["scale_events"],
+                    "wake_events": st["wake_events"],
+                    "last_wake_latency_s": st["last_wake_latency_s"],
+                }
+    except Exception:  # noqa: BLE001 — panel must not fail the API
+        pass
+    out["serve_scaling"] = serve_scaling
+    try:
+        router = getattr(global_worker(), "remote_router", None)
+    except Exception:  # noqa: BLE001 — uninitialized process: the
+        router = None  # summary stays safe (documented contract)
+    if router is not None:
+        out["drain_reroutes"] = router.drain_reroutes
+        out["offloaded_objects"] = router.offloaded_objects
+        out["fn_preship_sent"] = router.fn_preship_sent
+    return out
+
+
 def ownership_summary() -> Dict[str, Any]:
     """Ownership-directory panel (`/api/head` role): the head's
     steady-state RPC + FT-log-append counters — the PRODUCTION

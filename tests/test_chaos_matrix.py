@@ -775,6 +775,126 @@ def _spawn_env(extra=None):
     return env
 
 
+# --------------------------------------------------------------------------
+# Elasticity rows (PR 12): kills and wire faults against the AUTOSCALER's
+# node-launch path — the scaling transient, not just steady state.
+# --------------------------------------------------------------------------
+def test_matrix_nodekill_during_launch_x_retry_path(tmp_path):
+    """Cell (NodeKiller × node launch): the seeded killer SIGKILLs a
+    node daemon WHILE the autoscaler is launching it (before the join
+    line). The bounded launch-retry path must absorb the kill — the
+    next attempt joins — with the attempt/failure counters recording
+    the murdered try, and never a silent half-member."""
+    import subprocess
+    import sys
+
+    from ray_tpu.autoscaler import LocalSubprocessProvider, NodeTypeConfig
+
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", "0"],
+        stdout=subprocess.PIPE, text=True, env=_spawn_env())
+    address = head.stdout.readline().strip().rsplit(" ", 1)[-1]
+    GlobalConfig.set("autoscaler_launch_retries", 3)
+    GlobalConfig.set("autoscaler_launch_backoff_s", 0.05)
+    try:
+        prov = LocalSubprocessProvider(
+            address, worker_mode="thread", env=_spawn_env())
+        spawned = []
+        real_spawn = prov._spawn
+
+        def killing_spawn(node_type):
+            proc = real_spawn(node_type)
+            spawned.append(proc)
+            if len(spawned) == 1:
+                # The seeded killer hits the LAUNCHING node: one shot,
+                # recorded, before it can print its join line.
+                killer = chaos.NodeKiller(
+                    [chaos.pid_kill_target("launching-node",
+                                           lambda: proc.pid,
+                                           kind="daemon", once=True)],
+                    seed=5, interval_s=(0.0, 0.01), max_kills=1)
+                killer.start()
+                for _ in range(200):
+                    if proc.poll() is not None:
+                        break
+                    time.sleep(0.05)
+                killer.stop()
+                assert [k for k in killer.kills if "error" not in k], \
+                    "the seeded kill never fired"
+            return proc
+
+        prov._spawn = killing_spawn
+        handle = prov.launch(NodeTypeConfig("base", {"CPU": 1}))
+        assert handle["client_id"]
+        assert prov.launch_attempts == 2, "kill must cost one attempt"
+        assert prov.launch_failures == 1
+        assert spawned[0].poll() is not None  # the victim died
+        assert spawned[1].poll() is None      # the retry lives
+        prov.terminate(handle)
+    finally:
+        GlobalConfig.reset()
+        for p in spawned:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=5)
+        head.kill()
+        head.wait(timeout=5)
+
+
+def test_matrix_wire_delay_x_scale_up_cold_start_bounded(tmp_path):
+    """Cell (frame delay × scale-up): a node launched WITH seeded wire
+    delays armed (inherited via RAY_TPU_CHAOS) still joins inside the
+    launch grace window — the cold-start SLO holds under wire chaos —
+    and serves a real task end to end."""
+    from ray_tpu.autoscaler import (
+        ClusterAutoscaler,
+        LocalSubprocessProvider,
+        NodeTypeConfig,
+    )
+    import subprocess
+    import sys
+
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", "0", "--state", str(tmp_path / "state.log")],
+        stdout=subprocess.PIPE, text=True, env=_spawn_env())
+    address = head.stdout.readline().strip().rsplit(" ", 1)[-1]
+    chaos_env = {"RAY_TPU_CHAOS": json.dumps({
+        "seed": 6, "delay": 0.3, "delay_ms": 5, "sites": ["head"]})}
+    scaler = None
+    try:
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+        GlobalConfig.set("autoscaler_launch_grace_s", 30.0)
+        scaler = ClusterAutoscaler(
+            address,
+            [NodeTypeConfig("base", {"CPU": 2}, min_workers=1,
+                            max_workers=1)],
+            provider=LocalSubprocessProvider(
+                address, worker_mode="thread",
+                env=_spawn_env(chaos_env)),
+            idle_timeout_s=3600.0, update_interval_s=0.5)
+        summ = scaler.summary()
+        assert summ["launch_failures"] == 0, summ
+        events = [e for e in summ["scale_events"] if e.get("joined")]
+        assert events, "no scale-up event recorded"
+        assert events[0]["join_latency_s"] < 30.0  # inside the grace
+
+        @ray_tpu.remote
+        def probe(x):
+            return x + 1
+
+        assert ray_tpu.get(probe.remote(1), timeout=60) == 2
+    finally:
+        if scaler is not None:
+            scaler.shutdown()
+        ray_tpu.shutdown()
+        GlobalConfig.reset()
+        head.kill()
+        head.wait(timeout=5)
+
+
 def _spawn_cluster(tmp_path, n_nodes=2, node_env=None):
     import subprocess
     import sys

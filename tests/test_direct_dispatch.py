@@ -37,6 +37,7 @@ def _bare_router():
     r._lock = threading.Lock()
     r._inflight = {}
     r._assigned = {}
+    r._draining_nodes = {}
     r._oid_owner = {}
     r._oid_sizes = {}
     r._task_node = {}
@@ -220,6 +221,8 @@ def _bare_daemon():
     from ray_tpu._private.node_daemon import NodeDaemon
 
     d = NodeDaemon.__new__(NodeDaemon)
+    d._draining = False
+    d.drain_refusals = 0
     d._fn_cache = OrderedDict()
     d._fn_cache_bytes = 0
     d._fn_cache_cap = 64 << 20
@@ -317,42 +320,37 @@ def test_check_bench_requires_cluster_metric(tmp_path):
     # Every required metric present and holding -> gate passes (PR 5
     # adds llm_serving.continuous_tokens_per_sec, PR 7 adds
     # llm_prefix.cached_tokens_per_sec, PR 8 adds
-    # chaos_slo.p99_ttft_under_kill, and PR 10 adds the ownership
-    # flatness headline to the required set).
-    _write("BENCH_pr03.json",
-           {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
-            "streaming": {"backpressured_items_per_sec": 150.0},
-            "llm_serving": {"continuous_tokens_per_sec": 1000.0},
-            "llm_prefix": {"cached_tokens_per_sec": 400.0},
-            "chaos_slo": {"p99_ttft_under_kill": 30.0},
-            "ownership": {"head_rpcs_per_1k_objects": 0.0}})
+    # chaos_slo.p99_ttft_under_kill, PR 10 adds the ownership
+    # flatness headline, and PR 12 adds the elastic-episode TTFT to
+    # the required set).
+    def _green(**over):
+        rec = {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
+               "streaming": {"backpressured_items_per_sec": 150.0},
+               "llm_serving": {"continuous_tokens_per_sec": 1000.0},
+               "llm_prefix": {"cached_tokens_per_sec": 400.0},
+               "chaos_slo": {"p99_ttft_under_kill": 30.0},
+               "ownership": {"head_rpcs_per_1k_objects": 0.0},
+               "elastic_slo": {"p99_ttft_under_scale": 20.0}}
+        rec.update(over)
+        return rec
+
+    _write("BENCH_pr03.json", _green())
     assert check_bench.main(["--dir", str(tmp_path)]) == 0
+    # Missing the elastic-episode requirement (suite skipped) -> fails.
+    _write("BENCH_pr03.json",
+           _green(elastic_slo={"skipped": "spin-up failed"}))
+    assert check_bench.main(["--dir", str(tmp_path)]) == 1
     # Flatness is an ABSOLUTE gate: a head back in the object plane
     # (nonzero marginal RPCs per 1k objects) fails even with no prior.
     _write("BENCH_pr03.json",
-           {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
-            "streaming": {"backpressured_items_per_sec": 150.0},
-            "llm_serving": {"continuous_tokens_per_sec": 1000.0},
-            "llm_prefix": {"cached_tokens_per_sec": 400.0},
-            "chaos_slo": {"p99_ttft_under_kill": 30.0},
-            "ownership": {"head_rpcs_per_1k_objects": 42.0}})
+           _green(ownership={"head_rpcs_per_1k_objects": 42.0}))
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
-    _write("BENCH_pr03.json",
-           {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
-            "streaming": {"backpressured_items_per_sec": 150.0},
-            "llm_serving": {"continuous_tokens_per_sec": 1000.0},
-            "llm_prefix": {"cached_tokens_per_sec": 400.0},
-            "chaos_slo": {"p99_ttft_under_kill": 30.0},
-            "ownership": {"head_rpcs_per_1k_objects": 0.0}})
+    _write("BENCH_pr03.json", _green())
     # A later record whose streaming throughput regressed vs the last
     # record carrying it -> gate fails.
     _write("BENCH_pr04.json",
-           {"cluster_fanout_1k": {"tasks_per_sec": 240.0},
-            "streaming": {"backpressured_items_per_sec": 60.0},
-            "llm_serving": {"continuous_tokens_per_sec": 1000.0},
-            "llm_prefix": {"cached_tokens_per_sec": 400.0},
-            "chaos_slo": {"p99_ttft_under_kill": 30.0},
-            "ownership": {"head_rpcs_per_1k_objects": 0.0}})
+           _green(cluster_fanout_1k={"tasks_per_sec": 240.0},
+                  streaming={"backpressured_items_per_sec": 60.0}))
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     assert key  # silence linters: key documents the gated metric
 

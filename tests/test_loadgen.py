@@ -1,0 +1,120 @@
+"""Traffic-shape DSL tests: seeded replayable schedules, phase
+composition, rate integration, and the open-loop generator's outcome
+accounting (reference model: the serve release tests' traffic drivers,
+here a library with the chaos plane's replay contract)."""
+
+import threading
+import time
+
+from ray_tpu.util import loadgen
+
+
+def test_schedule_is_seeded_and_replayable():
+    shape = (loadgen.Ramp(1.0, 10.0, 5.0)
+             >> loadgen.Spike(20.0, 2.0)
+             >> loadgen.Ramp(10.0, 1.0, 5.0))
+    a = shape.schedule(seed=7)
+    b = shape.schedule(seed=7)
+    c = shape.schedule(seed=8)
+    assert a == b, "same (shape, seed) must replay identically"
+    assert a != c, "different seeds must differ"
+    assert all(0 <= t < shape.duration_s for t in a)
+    assert a == sorted(a), "arrivals are ordered"
+
+
+def test_schedule_count_tracks_integrated_rate():
+    # Expected arrivals = integral of rate: ramp 0->10 over 10s = 50,
+    # spike 20 rps x 2 s = 40, total 90. Poisson spread: 4 sigma ~ 38.
+    shape = loadgen.Ramp(0.0, 10.0, 10.0) >> loadgen.Spike(20.0, 2.0)
+    n = len(shape.schedule(seed=3))
+    assert 50 <= n <= 130, n
+
+
+def test_phase_rates_compose_piecewise():
+    shape = (loadgen.Step(2.0, 4.0)
+             >> loadgen.Ramp(2.0, 6.0, 4.0)
+             >> loadgen.Diurnal(5.0, 3.0, 8.0, cycles=2))
+    assert shape.duration_s == 4.0 + 4.0 + 16.0
+    assert shape.rate_at(1.0) == 2.0                    # step
+    assert abs(shape.rate_at(6.0) - 4.0) < 1e-9        # ramp midpoint
+    assert abs(shape.rate_at(8.0 + 2.0) - 8.0) < 1e-9  # diurnal peak
+    assert shape.rate_at(-1.0) == 0.0
+    assert shape.rate_at(100.0) == 0.0
+    assert shape.peak_rate() == 8.0
+    kinds = [d["kind"] for d in shape.describe()]
+    assert kinds == ["Step", "Ramp", "Diurnal"]
+
+
+def test_diurnal_floors_at_zero():
+    d = loadgen.Diurnal(1.0, 5.0, 4.0)
+    assert d.rate_at(3.0) == 0.0  # trough clamps instead of going negative
+    assert d.peak_rate() == 6.0
+
+
+def test_generator_drives_fire_and_records_outcomes():
+    shape = loadgen.Step(50.0, 0.4)
+    calls = []
+
+    def fire(i, t):
+        calls.append(i)
+        if i % 5 == 1:
+            raise ValueError("boom")
+        return i * 2
+
+    gen = loadgen.LoadGenerator(shape, fire, seed=1, max_concurrency=8)
+    records = gen.run(timeout_s=30)
+    assert len(calls) == len(gen.schedule) == len(records)
+    ok = [r for r in records if r.outcome == "ok"]
+    errs = [r for r in records if r.outcome.startswith("error:")]
+    assert ok and all(r.value == r.index * 2 for r in ok)
+    assert errs and all(r.outcome == "error:ValueError" for r in errs)
+    s = gen.summary()
+    assert s["fired"] == len(records)
+    assert s["ok"] == len(ok) and s["errors"] == len(errs)
+
+
+def test_generator_open_loop_does_not_reshape_arrivals():
+    """A slow fire() must not stretch the schedule: arrivals keep their
+    clock (bounded pool) and the summary discloses dispatch lag."""
+    shape = loadgen.Step(40.0, 0.5)
+    started = []
+
+    def slow_fire(i, t):
+        started.append((i, time.perf_counter()))
+        time.sleep(0.05)
+
+    gen = loadgen.LoadGenerator(shape, slow_fire, seed=2,
+                                max_concurrency=64)
+    t0 = time.perf_counter()
+    gen.run(timeout_s=30)
+    wall = time.perf_counter() - t0
+    # ~20 arrivals x 50 ms each would be ~1 s closed-loop; open-loop
+    # with concurrency 64 finishes in ~schedule span + one fire.
+    assert wall < shape.duration_s + 0.5, wall
+    assert gen.summary()["max_lag_s"] < 0.25
+
+
+def test_generator_stop_skips_remaining():
+    shape = loadgen.Step(20.0, 2.0)
+    fired = []
+    gen = loadgen.LoadGenerator(shape, lambda i, t: fired.append(i),
+                                seed=4)
+    stopper = threading.Timer(0.3, gen.stop)
+    stopper.start()
+    records = gen.run(timeout_s=10)
+    stopper.cancel()
+    skipped = [r for r in records if r.outcome == "skipped"]
+    assert fired, "some requests fired before the stop"
+    assert skipped, "requests after stop() were skipped"
+
+
+def test_explicit_schedule_replay():
+    """A recorded schedule replays verbatim (the chaos-plane replay
+    idiom: artifacts carry the schedule, not just the seed)."""
+    shape = loadgen.Step(10.0, 1.0)
+    sched = shape.schedule(seed=9)
+    gen = loadgen.LoadGenerator(shape, lambda i, t: None,
+                                schedule=sched)
+    assert gen.schedule == sched
+    records = gen.run(timeout_s=10)
+    assert [r.scheduled_t for r in records] == sched
