@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: test analyze bench bench-control-plane bench-llm \
 	bench-llm-prefix bench-gate bench-chaos bench-ownership \
-	bench-elastic chaos-gate
+	bench-elastic bench-trace chaos-gate
 
 test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -64,6 +64,16 @@ bench-ownership:
 # elastic_slo.p99_ttft_under_scale is REQUIRED by check_bench.
 bench-elastic:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite elastic_slo
+
+# Tracing inertness probe: the real-cluster fan-out with tracing OFF
+# vs ARMED (spans recorded on every hop, context on every wire frame)
+# — the armed rate must stay >= 0.95x, then the gate requires the
+# committed record to carry the ratio and hold the floor.
+bench-trace:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite trace_overhead
+	$(PYTHON) scripts/check_bench.py \
+		--require trace_overhead.fanout_ratio \
+		--min trace_overhead.fanout_ratio=0.95
 
 # Deterministic chaos slice inside tier-1 time: the seeded fault-
 # injection / NodeKiller / shedding matrix cells (pytest -m chaos,

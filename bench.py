@@ -17,6 +17,7 @@ import json
 import statistics
 import sys
 import time
+from contextlib import contextmanager
 
 NORTH_STAR_TASKS_PER_SEC = 100_000.0
 
@@ -718,6 +719,61 @@ def bench_control_plane(repeats=5):
         result["cluster_fanout_1k"] = {"skipped": repr(e)}
     result["timing"] = ("two-point marginal over fresh-process probes, "
                         "paired-slope IQR")
+    return result
+
+
+def bench_trace_overhead(repeats=2):
+    """Config #16: tracing inertness on the REAL cluster plane — the
+    cp_cluster fan-out (driver with zero CPUs, every task crossing the
+    framed transport to a node daemon) with tracing OFF vs ARMED (root
+    span ambient: every task payload carries context, node daemons
+    record accept/queue/exec spans, completion reports stamp trace
+    events). The headline ``fanout_ratio`` = armed rate / off rate is
+    gated >= 0.95 (`make bench-trace`): instrumentation must stay
+    ~free. Measured INSIDE one cluster session per probe
+    (cp_cluster_trace): alternating untraced / traced fan-outs over
+    the same sockets and warm state, ratio = median of per-pair wall
+    ratios — separate-process walls on this host swing ±40% and would
+    gate noise, not tracing. The armed cp_cluster run also assembles
+    the cluster-wide trace (span count + distinct processes) as the
+    propagation proof."""
+    import os
+
+    result = {"suite": "trace_overhead"}
+    n = 2000
+    pair_ratios: list = []
+    off_walls: list = []
+    on_walls: list = []
+    try:
+        for _ in range(repeats):
+            probe = _run_probe("cp_cluster_trace", n)
+            pair_ratios.extend(probe["pair_ratios"])
+            off_walls.append(probe["off_wall_med_s"])
+            on_walls.append(probe["on_wall_med_s"])
+        os.environ["RAY_TPU_TRACE"] = "1"
+        counters = {k: v for k, v in
+                    _run_probe("cp_cluster", 1000).items()
+                    if k not in ("wall_s", "n")}
+    finally:
+        os.environ.pop("RAY_TPU_TRACE", None)
+    off_med = statistics.median(off_walls)
+    on_med = statistics.median(on_walls)
+    result.update({
+        "fanout_tasks": n,
+        "fanout_off_tasks_per_sec": n / off_med,
+        "fanout_on_tasks_per_sec": n / on_med,
+        "fanout_ratio": statistics.median(pair_ratios),
+        "pair_ratios": [round(r, 4) for r in sorted(pair_ratios)],
+        "repeats": repeats,
+        "traced_counters": counters,
+        "timing": ("in-session A/B: alternating untraced vs traced "
+                   "fan-outs (8 pairs per probe process, ratio = "
+                   "median per-pair wall ratio); daemons stay armed "
+                   "via RAY_TPU_TRACE both ways — a task with no "
+                   "trace context pays only the inert `is None` "
+                   "branches, pinned costless by tests/"
+                   "test_tracing.py"),
+    })
     return result
 
 
@@ -1572,6 +1628,12 @@ def bench_elastic_slo(n_low=12, max_new=4):
     chaos_json = ('{"seed": 12, "delay": 0.08, "delay_ms": 2, '
                   '"dup": 0.01, "sites": ["peer"]}')
     env["RAY_TPU_CHAOS"] = chaos_json
+    # Tracing armed for the WHOLE episode (head, autoscaler-launched
+    # nodes, replica workers inherit): the wake request below must
+    # assemble into one cross-process trace, and engines record the
+    # TTFT decomposition.
+    env["RAY_TPU_TRACE"] = "1"
+    os.environ["RAY_TPU_TRACE"] = "1"
 
     import ray_tpu
     from ray_tpu import serve
@@ -1616,8 +1678,11 @@ def bench_elastic_slo(n_low=12, max_new=4):
             address,
             [NodeTypeConfig("serve", {"CPU": 2}, min_workers=0,
                             max_workers=3)],
-            provider=LocalSubprocessProvider(
-                address, worker_mode="thread", env=env),
+            # Default (process) worker mode: replicas live in dedicated
+            # REPLICA WORKER processes on their nodes — the wake trace
+            # below must cross driver → head → node daemon → replica
+            # worker as four distinct OS processes.
+            provider=LocalSubprocessProvider(address, env=env),
             idle_timeout_s=8.0, update_interval_s=0.5)
 
         serve.start()
@@ -1779,16 +1844,44 @@ def bench_elastic_slo(n_low=12, max_new=4):
         replica_stats = list(sampled_stats.values())
         scale_events = scaler.summary()["scale_events"]
         cold_starts = []
+        cold_start_decomp = []
         for ev in scale_events:
             if ev.get("joined") is None:
                 continue
-            cands = [st["first_token_monotonic"] for st in replica_stats
+            cands = [st for st in replica_stats
                      if st.get("first_token_monotonic") is not None
                      and st.get("init_started_monotonic", 0)
                      >= ev["launch_started"]]
             if cands:
-                cold_starts.append(min(cands) - ev["launch_started"])
+                st = min(cands,
+                         key=lambda s: s["first_token_monotonic"])
+                cold_starts.append(st["first_token_monotonic"]
+                                   - ev["launch_started"])
+                # Launch→join→replica-init→engine-ready→first-token:
+                # the cold-start half of the TTFT decomposition.
+                cold_start_decomp.append({
+                    "launch_to_join_s": ev["joined"]
+                    - ev["launch_started"],
+                    "join_to_replica_init_s": max(
+                        st["init_started_monotonic"] - ev["joined"],
+                        0.0),
+                    "engine_init_s": st["ready_monotonic"]
+                    - st["init_started_monotonic"],
+                    "ready_to_first_token_s": st["first_token_monotonic"]
+                    - st["ready_monotonic"],
+                    "total_s": st["first_token_monotonic"]
+                    - ev["launch_started"],
+                })
         cold_starts.sort()
+        # Engine-side TTFT decomposition (queue vs prefill vs decode):
+        # per-replica percentile rollups sampled through the episode;
+        # the headline aggregate is the busiest replica's view.
+        ttft_per_replica = [st.get("ttft_decomposition")
+                            for st in replica_stats
+                            if st.get("ttft_decomposition")]
+        ttft_decomp = max(
+            (d for d in ttft_per_replica if d.get("completed")),
+            key=lambda d: d["completed"], default=None)
 
         # The fall: deployment scales to zero, idle nodes drain + reap.
         t0 = time.monotonic()
@@ -1812,11 +1905,34 @@ def bench_elastic_slo(n_low=12, max_new=4):
         # Scale-from-zero wake: one request relaunches the loop
         # (replica target 0 -> 1, node launch, engine init, tokens).
         # Fresh retry budget: the episode deadline may be nearly spent
-        # after a slow traffic phase + fall wait.
+        # after a slow traffic phase + fall wait. Traced end to end:
+        # the ambient root rides the serve handle into the wake, the
+        # cold-start stash hands it to the autoscaler's launch, the
+        # launched daemon + head + replica worker all record spans.
+        from ray_tpu._private import tracing as _tracing
+
         episode_deadline = time.monotonic() + 180.0
+        wake_span = _tracing.begin("episode.wake_request")
         t0 = time.perf_counter()
         wake_outcome = run_stream(99_999, 0)
         wake_wall = time.perf_counter() - t0
+        _tracing.finish(wake_span)
+        wake_trace = None
+        if wake_span is not None:
+            time.sleep(1.5)  # let node reports/spill files land
+            from ray_tpu.util.state import trace_summary
+
+            summ = trace_summary(wake_span.ctx.trace_id)
+            wake_trace = {
+                "trace_id": wake_span.ctx.trace_id,
+                "num_spans": summ["num_spans"],
+                "num_processes": summ["num_processes"],
+                "components": summ["components"],
+                "nodes": summ["nodes"],
+                "span_names": sorted({s["name"]
+                                      for s in summ["spans"]}),
+                "wall_span_s": summ["wall_span_s"],
+            }
 
         ok_high = sorted(t for c, o, t, _ in episode_results
                          if c == 0 and o == "ok")
@@ -1876,6 +1992,10 @@ def bench_elastic_slo(n_low=12, max_new=4):
             "post_fall": post_fall,
             "wake_events": serve_st["wake_events"],
             "scale_to_zero_wake_wall_s": wake_wall,
+            "wake_trace": wake_trace,
+            "cold_start_decomposition_s": cold_start_decomp,
+            "ttft_decomposition": ttft_decomp,
+            "ttft_decomposition_per_replica": ttft_per_replica,
             "warmed_prefix_tokens_per_replica": [
                 st.get("warmed_prefix_tokens") for st in replica_stats],
             "wire_fault_counters": chaos_util.wire_counters(),
@@ -1902,6 +2022,7 @@ def bench_elastic_slo(n_low=12, max_new=4):
             pass
         chaos_util.uninstall()
         os.environ.pop("RAY_TPU_CHAOS", None)
+        os.environ.pop("RAY_TPU_TRACE", None)
         for p in reversed(procs):
             p.kill()
             p.wait(timeout=5)
@@ -1930,6 +2051,74 @@ def bench_rl_rollout(repeats=6):
         }
     except Exception as e:  # noqa: BLE001 — suite optional until built
         return {"suite": "rl_rollout", "skipped": repr(e)}
+
+
+@contextmanager
+def _cluster_probe_session(trace: bool = False):
+    """One real-cluster probe session shared by the cp_cluster and
+    cp_cluster_trace probes: a head + one node daemon as subprocesses,
+    a ZERO-CPU driver (every task crosses the framed transport), a
+    registered ``noop`` fan-out function, and the node's direct server
+    address confirmed in the directory (otherwise the first pushes
+    measure the relay fallback, not the fast path). Yields
+    ``(noop, worker)``; owns teardown. ``trace=True`` arms
+    RAY_TPU_TRACE in the session AND every spawned process, and scrubs
+    it on exit; ``trace=False`` inherits the caller's environment
+    unchanged (the trace_overhead suite arms it there)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if trace:
+        env["RAY_TPU_TRACE"] = "1"
+        os.environ["RAY_TPU_TRACE"] = "1"
+    # The head/node subprocesses import ray_tpu by module path.
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    try:
+        head = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", "0"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(head)
+        line = head.stdout.readline()
+        assert "listening" in line, f"head failed to start: {line!r}"
+        address = line.strip().rsplit(" ", 1)[-1]
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_daemon",
+             "--address", address, "--num-cpus", "2",
+             "--worker-mode", "thread"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(node)
+        line = node.stdout.readline()
+        assert "joined" in line, f"node failed to join: {line!r}"
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=address)
+
+        @ray_tpu.remote
+        def noop(x):
+            return x
+
+        w = ray_tpu._private.worker.global_worker()
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            nodes = w.head_client.node_list()
+            if nodes and all(n_.get("peer_addr") for n_ in nodes):
+                break
+            time.sleep(0.1)
+        yield noop, w
+    finally:
+        for p in reversed(procs):
+            p.kill()
+            p.wait(timeout=5)
+        if trace:
+            os.environ.pop("RAY_TPU_TRACE", None)
 
 
 def _probe_main(args):
@@ -2013,61 +2202,71 @@ def _probe_main(args):
             out = ray_tpu.get(refs, timeout=600)
             assert out == list(range(n))  # byte-identical results
         wall = time.perf_counter() - t0
-    elif args.probe == "cp_cluster":
-        import os
-        import subprocess
+    elif args.probe == "cp_cluster_trace":
+        # Tracing-overhead A/B inside ONE cluster session: the same
+        # driver/head/daemon processes (RAY_TPU_TRACE armed everywhere)
+        # run alternating untraced / traced fan-outs — no ambient root
+        # span means no context on any payload (the off path plus its
+        # inert branches); a root span turns on full per-task
+        # propagation + span recording on every hop. Same sockets, same
+        # warm state, back-to-back: process-level host noise (which
+        # swings ±40% between separate probe processes on this host)
+        # cancels in the per-pair ratio.
+        import statistics as _stats
 
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        # The head/node subprocesses import ray_tpu by module path.
-        repo = os.path.dirname(os.path.abspath(__file__))
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-        procs = []
-        try:
-            head = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.head_service",
-                 "--port", "0"],
-                stdout=subprocess.PIPE, text=True, env=env)
-            procs.append(head)
-            line = head.stdout.readline()
-            assert "listening" in line, f"head failed to start: {line!r}"
-            address = line.strip().rsplit(" ", 1)[-1]
-            node = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.node_daemon",
-                 "--address", address, "--num-cpus", "2",
-                 "--worker-mode", "thread"],
-                stdout=subprocess.PIPE, text=True, env=env)
-            procs.append(node)
-            line = node.stdout.readline()
-            assert "joined" in line, f"node failed to join: {line!r}"
+        with _cluster_probe_session(trace=True) as (noop, _w):
+            import ray_tpu
+            from ray_tpu._private import tracing as _tracing
+
+            assert _tracing.active()
+
+            def timed(traced: bool) -> float:
+                root = _tracing.begin("bench.traced_fanout") \
+                    if traced else None
+                t0 = time.perf_counter()
+                refs = [noop.remote(i) for i in range(n)]
+                out = ray_tpu.get(refs, timeout=600)
+                wall_x = time.perf_counter() - t0
+                _tracing.finish(root)
+                assert out == list(range(n))
+                return wall_x
+
+            timed(False)  # warm both paths, untimed
+            timed(True)
+            pair_ratios = []
+            off_walls, on_walls = [], []
+            for _ in range(8):
+                a = timed(False)
+                b = timed(True)
+                off_walls.append(a)
+                on_walls.append(b)
+                pair_ratios.append(a / b)
+            wall = sum(off_walls) + sum(on_walls)
+            t = _tracing.tracer()
+            extra = {
+                "pair_ratios": [round(r, 4) for r in pair_ratios],
+                "ratio_median": _stats.median(pair_ratios),
+                "off_wall_med_s": _stats.median(off_walls),
+                "on_wall_med_s": _stats.median(on_walls),
+                "driver_spans": t.spans_recorded if t else 0,
+            }
+    elif args.probe == "cp_cluster":
+        with _cluster_probe_session() as (noop, w):
             import ray_tpu
 
-            # Zero local CPUs: every task crosses the transport to the
-            # node daemon and its results pull back over the wire.
-            ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
-                         address=address)
-
-            @ray_tpu.remote
-            def noop(x):
-                return x
-
-            w = ray_tpu._private.worker.global_worker()
-            # Steady state starts once the node's direct server address
-            # has ridden a heartbeat into the directory (otherwise the
-            # first pushes measure the relay fallback, not the fast path).
-            deadline = time.perf_counter() + 5.0
-            while time.perf_counter() < deadline:
-                nodes = w.head_client.node_list()
-                if nodes and all(n_.get("peer_addr") for n_ in nodes):
-                    break
-                time.sleep(0.1)
             assert ray_tpu.get(noop.remote(41), timeout=60) == 41
+            from ray_tpu._private import tracing
+
+            # With RAY_TPU_TRACE armed (the trace_overhead suite), the
+            # timed fan-out runs under one root span so every task
+            # carries — and pays for — on-wire context propagation.
+            root = tracing.begin("bench.cluster_fanout") \
+                if tracing.active() else None
             t0 = time.perf_counter()
             refs = [noop.remote(i) for i in range(n)]
             out = ray_tpu.get(refs, timeout=600)
             wall = time.perf_counter() - t0
+            tracing.finish(root)
             assert out == list(range(n))
             r = w.remote_router
             hc = w.head_client
@@ -2086,10 +2285,17 @@ def _probe_main(args):
                 "head_msgs": hc.req_msgs_sent,
                 "head_msgs_per_task": hc.req_msgs_sent / max(n, 1),
             }
-        finally:
-            for p in reversed(procs):
-                p.kill()
-                p.wait(timeout=5)
+            if root is not None:
+                # Outside the timed region: let the node's coalesced
+                # reports land, then assemble the cluster-wide trace —
+                # the propagation proof riding the overhead probe.
+                time.sleep(0.5)
+                from ray_tpu.util.state import trace_summary
+
+                summ = trace_summary(root.ctx.trace_id)
+                extra["trace_spans_cluster"] = summ["num_spans"]
+                extra["trace_processes"] = summ["num_processes"]
+                extra["trace_components"] = ",".join(summ["components"])
     elif args.probe == "rl":
         from ray_tpu.rl.env import CartPole
         from ray_tpu.rl.env_runner import EnvRunner
@@ -2130,7 +2336,8 @@ def main():
     parser.add_argument("--suite", choices=[
         "chain", "fanout", "actor", "data", "rl", "model", "sharded",
         "control_plane", "workflow", "streaming", "llm_serving",
-        "llm_prefix", "chaos_slo", "ownership", "elastic_slo"],
+        "llm_prefix", "chaos_slo", "ownership", "elastic_slo",
+        "trace_overhead"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -2158,6 +2365,7 @@ def main():
         "chaos_slo": bench_chaos_slo,
         "ownership": bench_ownership,
         "elastic_slo": bench_elastic_slo,
+        "trace_overhead": bench_trace_overhead,
     }
 
     if args.suite:

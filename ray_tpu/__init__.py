@@ -62,9 +62,34 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
     "__version__",
 ]
+
+
+def timeline(trace_id=None, filename=None):
+    """Chrome-tracing JSON (``ray.timeline`` parity). Without
+    ``trace_id``: this driver's task-event timeline (which now includes
+    node-shipped events). With ``trace_id`` (tracing armed via
+    RAY_TPU_TRACE): the CLUSTER-WIDE assembled trace — spans pulled
+    from every process the request crossed. ``filename`` writes the
+    JSON for chrome://tracing / Perfetto and returns the path."""
+    if trace_id is not None:
+        from ray_tpu.util.state import trace_summary
+
+        events = trace_summary(trace_id)["chrome_trace"]
+    else:
+        from ray_tpu.util.state import get_timeline
+
+        events = get_timeline()
+    if filename is not None:
+        import json as _json
+
+        with open(filename, "w") as f:
+            _json.dump(events, f)
+        return filename
+    return events
 
 
 def available_resources():

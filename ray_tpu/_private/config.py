@@ -244,6 +244,15 @@ _D("autoscaler_drain_timeout_s", float, 15.0,
    "bytes to lease-transfer (object_offload to their owner + "
    "object_transfer re-point of head fallback entries) before the "
    "provider terminates it.")
+_D("trace_max_spans", int, 65536,
+   "Per-process span ring capacity for the distributed tracing plane "
+   "(RAY_TPU_TRACE arms tracing; off = zero spans, zero wire bytes).")
+_D("trace_cold_start_window_s", float, 180.0,
+   "How long a launched node daemon keeps RAY_TPU_TRACE_PARENT in its "
+   "environment: worker processes spawned inside the window parent "
+   "their init spans to the launching request's trace; past it the "
+   "variable is dropped so later unrelated scale-ups on a long-lived "
+   "node aren't misattributed to a finished trace.")
 _D("serve_wake_timeout_s", float, 30.0,
    "Scale-to-zero wake bound: a request arriving at a deployment with "
    "zero replicas queues while the controller scales it back up, and "

@@ -549,8 +549,41 @@ class HeadClient:
         return data
 
     # --------------------------------------------------------------- nodes
-    def node_register(self, node_id: str, resources: Dict[str, float]):
-        return self._request(("node_register", node_id, dict(resources)))
+    def node_register(self, node_id: str, resources: Dict[str, float],
+                      trace=None):
+        """``trace`` (a ``tracing.inject`` tuple, only ever non-None
+        when tracing is armed) lets the head record the JOIN half of a
+        traced cold start; absent = zero extra wire bytes."""
+        msg = ("node_register", node_id, dict(resources))
+        if trace is not None:
+            msg = msg + (tuple(trace),)
+        return self._request(msg)
+
+    def trace_dump(self, trace_id: str = "") -> list:
+        """The head process's span ring (trace assembly input)."""
+        return list(self._request(("trace_dump", trace_id)) or [])
+
+    def trace_index(self) -> dict:
+        """The head process's per-trace aggregates (the index input:
+        O(traces) on the wire, no span materialization)."""
+        return dict(self._request(("trace_dump", "", True)) or {})
+
+    def node_trace_dump(self, target_client: str,
+                        trace_id: str = "") -> list:
+        """Head-relayed trace_dump from one node (fallback for nodes
+        whose direct server this process cannot dial)."""
+        return list(self._request(
+            ("node_trace_dump", target_client, trace_id)) or [])
+
+    def node_trace_index(self, target_client: str) -> dict:
+        """Head-relayed trace_index from one node (same fallback)."""
+        return dict(self._request(
+            ("node_trace_dump", target_client, "", True)) or {})
+
+    def node_metrics_dump(self, target_client: str) -> str:
+        """Head-relayed metrics scrape from one node."""
+        return self._request(
+            ("node_metrics_dump", target_client)) or ""
 
     def node_list(self):
         return [dict(n) for n in self._request(("node_list",))]

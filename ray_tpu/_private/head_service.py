@@ -360,6 +360,18 @@ class HeadService:
         # the common zero-subscriber case makes announce-path object
         # events O(1) instead of an O(clients) scan.
         self._obj_sub_count = 0
+        # Distributed tracing (RAY_TPU_TRACE in the env): the head
+        # records its half of traced control hops (node joins) and
+        # answers trace_dump; off = the usual one-branch inertness.
+        from ray_tpu._private import tracing as _tracing
+
+        _tracing.install_from_env(component="head")
+        # Cluster metrics scrape plane: a PeerPool for pulling each
+        # node's /metrics registry over its direct object server
+        # (lazily used by serve_cluster_metrics / the metrics_scrape
+        # RPC; costs nothing while nobody scrapes).
+        self._metrics_peers = None
+        self._metrics_server = None
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
@@ -801,17 +813,53 @@ class HeadService:
                     owner, ("object_chunk", oid_bin, offset, length),
                     timeout=60.0)
             if kind == "node_register":
-                _, node_id, resources = msg
+                _, node_id, resources = msg[:3]
                 with self._lock:
                     c.is_node = True
                     c.node_id = node_id
                     c.resources = dict(resources)
                 self._persist("node_register", client_id, node_id,
                               dict(resources))
+                if len(msg) > 3 and msg[3] is not None:
+                    # Traced cold start: the launched node carried its
+                    # trace context here — the head records the JOIN
+                    # hop (launch → join → replica init → first token).
+                    from ray_tpu._private import tracing as _tracing
+
+                    _tracing.event(
+                        "node.join", ctx=_tracing.extract(msg[3]),
+                        component="head", client=client_id,
+                        node_id=node_id)
                 self._publish("ray_tpu:node_events", {
                     "event": "node_added", "client_id": client_id,
                     "node_id": node_id, "resources": dict(resources)})
                 return ("ok", None)
+            if kind == "trace_dump":
+                from ray_tpu._private import tracing as _tracing
+
+                t = _tracing.tracer()
+                tid = msg[1] if len(msg) > 1 else ""
+                if isinstance(tid, bytes):
+                    tid = tid.decode()
+                if len(msg) > 2 and msg[2]:
+                    return ("ok", t.trace_index(include_dir=False)
+                            if t is not None else {})
+                return ("ok", t.dump(trace_id=tid or None,
+                                     include_dir=False)
+                        if t is not None else [])
+            if kind == "node_trace_dump":
+                target_client, tid = msg[1], msg[2]
+                if not self._is_alive(target_client):
+                    return ("ok", [])
+                relayed = ("trace_dump", tid, True) \
+                    if len(msg) > 3 and msg[3] else ("trace_dump", tid)
+                return self._relay(target_client, relayed, timeout=15.0)
+            if kind == "node_metrics_dump":
+                _, target_client = msg
+                if not self._is_alive(target_client):
+                    return ("ok", "")
+                return self._relay(target_client, ("metrics_dump",),
+                                   timeout=15.0)
             if kind == "node_list":
                 # peer_addr is the node's direct request/object server —
                 # drivers dial it once and push task batches peer-to-peer
@@ -991,10 +1039,120 @@ class HeadService:
                     "event": "node_dead", "client_id": cid,
                     "node_id": node_id})
 
+    # ------------------------------------------------------ cluster metrics
+    def serve_cluster_metrics(self, host: str = "127.0.0.1",
+                              port: int = 0):
+        """ONE Prometheus surface for the whole cluster (reference: the
+        per-node metrics agents scraped into one Prometheus): GET
+        /metrics scrapes this head's registry plus every live node's
+        (direct object-server pull, event-channel relay fallback), each
+        series re-labeled with ``node``/``component`` tags. Returns the
+        (host, port) actually bound."""
+        import http.server
+
+        from ray_tpu._private.object_server import PeerPool
+        from ray_tpu.util.metrics import Gauge
+
+        # Eager, single-threaded init: the handler below runs on one
+        # thread PER REQUEST (ThreadingHTTPServer) — lazy creation
+        # there would race, registering duplicate gauge families and
+        # leaking a second PeerPool's sockets.
+        self._m_rpc_total = Gauge(
+            "ray_tpu_head_rpc_total",
+            "Total control RPCs this head has served")
+        self._m_nodes_alive = Gauge(
+            "ray_tpu_head_nodes_alive", "Live node daemons")
+        self._metrics_peers = PeerPool(self.token)
+
+        svc = self
+
+        class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = svc._cluster_metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._metrics_server = http.server.ThreadingHTTPServer(
+            (host, port), _MetricsHandler)
+        threading.Thread(
+            target=self._metrics_server.serve_forever, daemon=True,
+            name="head-cluster-metrics").start()
+        return self._metrics_server.server_address[:2]
+
+    def _cluster_metrics_text(self) -> str:
+        from ray_tpu.util.metrics import (
+            export_prometheus,
+            merge_prometheus,
+            relabel_prometheus,
+        )
+
+        with self._lock:
+            self._m_rpc_total.set(float(sum(self.rpc_counts.values())))
+            self._m_nodes_alive.set(float(sum(
+                1 for cl in self._clients.values()
+                if cl.is_node and cl.alive)))
+        parts = [relabel_prometheus(
+            export_prometheus(), {"node": "head", "component": "head"})]
+        with self._lock:
+            nodes = [(c.client_id, c.peer_addr)
+                     for c in self._clients.values()
+                     if c.is_node and c.alive]
+
+        def scrape_one(item):
+            cid, addr = item
+            if addr is not None:
+                try:
+                    return self._metrics_peers.call(
+                        tuple(addr), ("metrics_dump",))
+                except Exception as exc:  # noqa: BLE001 — NAT/dead dial
+                    log.debug("direct metrics scrape of %s failed; "
+                              "trying the relay: %r", cid, exc)
+            try:
+                status, text = self._relay(
+                    cid, ("metrics_dump",), timeout=5.0)
+                return text if status == "ok" else None
+            except Exception as exc:  # noqa: BLE001 — node mid-death
+                log.debug("relayed metrics scrape of %s failed; "
+                          "node skipped this scrape: %r", cid, exc)
+                return None
+
+        if nodes:
+            # Concurrent fan-out: unreachable nodes cost one dial+relay
+            # window in PARALLEL, not serially — a Prometheus scrape of
+            # a cluster with dying nodes stays inside its scrape
+            # timeout instead of stacking every dead node's ~10 s.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(nodes)),
+                    thread_name_prefix="head-metrics-scrape") as pool:
+                texts = list(pool.map(scrape_one, nodes))
+            for (cid, _addr), text in zip(nodes, texts):
+                if text:
+                    parts.append(relabel_prometheus(
+                        str(text), {"node": cid, "component": "node"}))
+        return merge_prometheus(parts)
+
     def shutdown(self):
         self._stop.set()
         self._listener.close()
         self._rpc_pool.shutdown(wait=False, cancel_futures=True)
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
+        if self._metrics_peers is not None:
+            self._metrics_peers.close()
         if self._log is not None:
             self._log.close()
 
@@ -1046,6 +1204,11 @@ def main(argv=None) -> int:
     ap.add_argument("--standby-of", default=None, metavar="HOST:PORT",
                     help="run as a warm standby: serve only after this "
                          "primary (sharing --state) stops answering")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="cluster Prometheus scrape endpoint: the head "
+                         "pulls every live node's registry and serves "
+                         "the merged, node-tagged series on /metrics "
+                         "(0 = any free port, -1 = disabled)")
     args = ap.parse_args(argv)
     if args.standby_of:
         token = (args.token or os.environ.get("RAY_TPU_CLUSTER_TOKEN"))
@@ -1060,8 +1223,13 @@ def main(argv=None) -> int:
               flush=True)
     svc = HeadService(args.host, args.port, token=args.token,
                       state_path=args.state)
-    # Port on stdout so launchers with --port 0 can discover it.
+    # Port on stdout so launchers with --port 0 can discover it (FIRST
+    # line — existing launchers readline() exactly once for it).
     print(f"ray_tpu head listening on {svc.host}:{svc.port}", flush=True)
+    if args.metrics_port >= 0:
+        mhost, mport = svc.serve_cluster_metrics(
+            args.host, args.metrics_port)
+        print(f"ray_tpu head metrics on {mhost}:{mport}", flush=True)
     svc.serve_forever()
     return 0
 

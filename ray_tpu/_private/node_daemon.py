@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pickle
 import signal
 import threading
@@ -48,6 +49,7 @@ from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.log import get_logger
 from ray_tpu._private.scheduler import TaskSpec
+from ray_tpu._private import tracing
 
 log = get_logger(__name__)
 
@@ -110,13 +112,45 @@ class NodeDaemon:
     def __init__(self, address: str, num_cpus: int = 2,
                  resources: Dict[str, float] | None = None,
                  worker_mode: str | None = None):
+        import time as _time
+
         import ray_tpu
         from ray_tpu._private.worker import global_worker
 
+        init_t0 = _time.time()
         ray_tpu.init(num_cpus=num_cpus, resources=resources,
                      worker_mode=worker_mode, address=address)
         self.worker = global_worker()
         self.head = self.worker.head_client
+        # Cold-start chain: a node launched FOR a traced request carries
+        # RAY_TPU_TRACE_PARENT — its init (runtime boot → registration)
+        # becomes a span in that trace, and the join context rides the
+        # node_register RPC so the head records its half.
+        tracer = tracing.tracer()
+        self._join_trace = None
+        # The launch context is only meaningful for THIS cold start:
+        # once the window passes, drop it from our environment so
+        # worker processes spawned for later, unrelated scale-ups
+        # don't parent their replica.init into a long-finished trace.
+        self._trace_parent_expire = (
+            _time.monotonic() + GlobalConfig.trace_cold_start_window_s)
+        if tracer is not None:
+            tracer.set_identity(component="node",
+                                node=self.head.client_id)
+            # Spawned worker processes inherit this node identity so
+            # their spilled spans carry a cluster-unique process key.
+            os.environ[tracing.ENV_NODE] = self.head.client_id
+            parent = tracing.cold_start_parent()
+            if parent is not None:
+                span = tracing.begin("node.init", parent=parent,
+                                     component="node")
+                span.t0 = init_t0  # covers the runtime boot too
+                self._join_trace = tracing.inject(span.ctx)
+                self._init_span = span
+            else:
+                self._init_span = None
+        else:
+            self._init_span = None
         self.head.handlers["task_push"] = self._on_task_push
         # Direct plane: drivers dial this node's request server and push
         # task batches peer-to-peer (one vectored write per batch); the
@@ -152,9 +186,28 @@ class NodeDaemon:
         # (direct actor_op requests + head-relayed actor_push fallback).
         from ray_tpu._private.remote_actor import ActorHost
 
-        self.actor_host = ActorHost(self.worker, self.head)
+        # Created before ActorHost registers its handlers: an actor op
+        # can arrive the moment node_register lands, and its owner
+        # callback writes these.
+        self._seen_lock = threading.Lock()
+        self._last_owner: tuple | None = None  # (addr, driver_id)
+        self.actor_host = ActorHost(self.worker, self.head,
+                                    on_owner_seen=self._note_owner)
         self.head.node_register(
-            self.worker.node_id.hex(), self.worker.resource_pool.total)
+            self.worker.node_id.hex(), self.worker.resource_pool.total,
+            trace=self._join_trace)
+        if self._init_span is not None:
+            tracing.finish(self._init_span)
+            self._init_span = None
+        # Observability pull plane: peers/state clients dump this node's
+        # span ring (+ its worker processes' spilled spans) and its
+        # metrics registry — served on the direct object server with a
+        # head-relayed twin, zero steady-state cost.
+        self.head._object_server.handlers["trace_dump"] = self._on_trace_dump
+        self.head.handlers["trace_dump"] = self._on_trace_dump
+        self.head._object_server.handlers["metrics_dump"] = \
+            self._on_metrics_dump
+        self.head.handlers["metrics_dump"] = self._on_metrics_dump
         # Bounded pools replace the old thread-per-pushed-task model:
         # _intake unpacks + prefetches args + submits; _pulls runs the
         # concurrent argument pulls; _reporter ships task_done RPCs
@@ -176,7 +229,6 @@ class NodeDaemon:
 
         self._seen_tasks: set = set()
         self._seen_order: "_deque" = _deque()
-        self._seen_lock = threading.Lock()
         # Streaming tasks whose commit listener is already installed
         # (a replayed push must not double-report items).
         self._streaming_wired: set = set()
@@ -235,6 +287,47 @@ class NodeDaemon:
         self.drain_transferred = 0
         self.drain_untransferred = 0
         self.fn_preshipped = 0  # functions registered ahead of any push
+        # Task-event shipping cursor: the reporter piggybacks this
+        # node's ring (events recorded since the last flush) onto its
+        # coalesced completion batches — the driver's state API sees
+        # cluster tasks with ZERO new steady-state head RPCs.
+        self._events_cursor = 0
+        self.events_shipped = 0
+
+    def _note_owner(self, addr: tuple, driver_id):
+        """Remember the last driver this node reported to (set from
+        task completions AND actor ops): tail task events whose
+        terminal record landed after the final completion flush ship
+        to it on the next heartbeat tick — direct plane, zero head
+        RPCs."""
+        with self._seen_lock:
+            self._last_owner = (addr, driver_id)
+
+    # ------------------------------------------------------ observability
+    def _on_trace_dump(self, msg: tuple):
+        """This node's span ring + its worker processes' spilled spans,
+        optionally filtered to one trace id (hex str, '' = all). A
+        truthy third element asks for the per-trace INDEX instead of
+        full spans (O(traces) on the wire, the /api/traces listing)."""
+        trace_id = None
+        if len(msg) > 1 and msg[1]:
+            trace_id = msg[1].decode() if isinstance(msg[1], bytes) \
+                else str(msg[1])
+        t = tracing.tracer()
+        if len(msg) > 2 and msg[2]:
+            return t.trace_index() if t is not None else {}
+        return t.dump(trace_id=trace_id) if t is not None else []
+
+    def _on_metrics_dump(self, msg: tuple):
+        """This process's metrics registry in Prometheus text form; the
+        scraping side re-labels every sample with node/component tags."""
+        from ray_tpu.util.metrics import (
+            export_prometheus,
+            refresh_framework_metrics,
+        )
+
+        refresh_framework_metrics(self.worker)
+        return export_prometheus()
 
     # -------------------------------------------------------- function cache
     def _register_fn(self, fn_bytes: bytes) -> bytes:
@@ -340,6 +433,25 @@ class NodeDaemon:
             pass
 
     def _status(self) -> dict:
+        from ray_tpu.util.metrics import refresh_framework_metrics
+
+        # Heartbeat-rate refresh of the built-in gauges: every node's
+        # metrics_dump always carries current series for the cluster
+        # scrape to tag.
+        refresh_framework_metrics(self.worker)
+        if tracing.ENV_PARENT in os.environ \
+                and time.monotonic() > self._trace_parent_expire:
+            os.environ.pop(tracing.ENV_PARENT, None)
+        if self._last_owner is not None and \
+                self.worker.task_events.latest_seq() > self._events_cursor:
+            # Tail task events with no completion flush to ride (the
+            # terminal record can land after the last report went out):
+            # nudge the reporter to ship them direct. Owner-gated so a
+            # node nobody has reported to yet doesn't wake its reporter
+            # every heartbeat for events it cannot ship.
+            with self._report_cv:
+                self._report_q.append(("events",))
+                self._report_cv.notify()
         hosted = sum(1 for a in self.worker.actors.values()
                      if not getattr(a, "borrower", False))
         router = self.worker.remote_router
@@ -375,6 +487,15 @@ class NodeDaemon:
                 self.drain_refusals += 1
             return "draining"
         payload = pickle.loads(bytes(payload_bytes))
+        if tracing._TRACER is not None and payload.get("trace") is not None:
+            # submit→accept hop: register the context (one extract, one
+            # lock) so the scheduler's task-event bridge emits this
+            # task's queue/exec spans, and stamp the arrival.
+            ctx = tracing.extract(payload["trace"])
+            if ctx is not None:
+                tracing.register_task(bytes(payload["task_id"]), ctx)
+                tracing.event("task.accept", ctx=ctx, component="node",
+                              task=payload.get("name", ""))
         fn_bytes = payload.get("fn")
         digest = payload.get("fn_digest")
         if fn_bytes:
@@ -512,19 +633,45 @@ class NodeDaemon:
             owner = (payload.get("driver_id"), payload.get("driver_addr"))
             wired = list(payload["args"]) + list(payload["kwargs"].values())
             pull_bins = [bytes(d) for k, d in wired if k == "r"]
-            if payload.get("_gated"):
-                # Pending producers: this task runs on its OWN thread, so
-                # wait-out pulls happen inline — the shared pull pool
-                # stays free for immediately-resolvable transfers.
-                for ob in pull_bins:
-                    self._ensure_object(ob, deadline, owner)
-            elif pull_bins:
-                prefetched = prefetch_serialized(
-                    lambda ob: self._ensure_object(ob, deadline, owner),
-                    pull_bins, self._pulls)
-                for exc in prefetched.values():
-                    if isinstance(exc, BaseException):
-                        raise exc
+            dep_span = None
+            if pull_bins and tracing._TRACER is not None \
+                    and payload.get("trace") is not None:
+                dep_span = tracing.begin(
+                    "task.dep_fetch",
+                    parent=tracing.extract(payload["trace"]),
+                    component="node", task=payload.get("name", ""),
+                    num_deps=len(pull_bins))
+            try:
+                if payload.get("_gated"):
+                    # Pending producers: this task runs on its OWN
+                    # thread, so wait-out pulls happen inline — the
+                    # shared pull pool stays free for immediately-
+                    # resolvable transfers.
+                    for ob in pull_bins:
+                        self._ensure_object(ob, deadline, owner)
+                elif pull_bins:
+                    # Pool threads have no ambient thread-local trace
+                    # context: re-enter the dep-fetch span's so their
+                    # pull meta frames carry it (no-op when off).
+                    dep_ctx = dep_span.ctx if dep_span is not None \
+                        else None
+
+                    def _pull(ob, _ctx=dep_ctx):
+                        with tracing.use_context(_ctx):
+                            return self._ensure_object(ob, deadline,
+                                                       owner)
+
+                    prefetched = prefetch_serialized(
+                        _pull, pull_bins, self._pulls)
+                    for exc in prefetched.values():
+                        if isinstance(exc, BaseException):
+                            raise exc
+            except BaseException:
+                tracing.finish(dep_span, status="error")
+                dep_span = None
+                raise
+            finally:
+                tracing.finish(dep_span)
             args = tuple(self._unwire_arg(a, deadline, owner)
                          for a in payload["args"])
             kwargs = {k: self._unwire_arg(v, deadline, owner)
@@ -540,7 +687,8 @@ class NodeDaemon:
                 retry_exceptions=payload["retry_exceptions"],
                 runtime_env=payload.get("runtime_env"),
                 streaming=streaming,
-                backpressure=int(payload.get("backpressure", 0)))
+                backpressure=int(payload.get("backpressure", 0)),
+                trace=payload.get("trace"))
             self.worker.scheduler.submit(spec)
         except BaseException as exc:  # noqa: BLE001 — report, don't die
             from ray_tpu.exceptions import RayTaskError
@@ -594,15 +742,26 @@ class NodeDaemon:
                 while len(self._result_owner_order) > 65536:
                     self._result_owner.pop(
                         self._result_owner_order.popleft(), None)
-        done = pickle.dumps({
+        done_fields = {
             "task_id": bytes(payload["task_id"]),
             "oid_bins": oid_bins,
             "node_client": self.head.client_id,
             "sizes": sizes,
             "errs": errs,
             "inline": inline,
-        }, protocol=5)
+        }
+        # Ship this node's task-event ring home piggybacked on the
+        # completion report (exactly the coalesced batch that is going
+        # out anyway — no new RPC, no new frame): the driver ingests
+        # them so util.state.list_tasks() covers cluster tasks.
+        events = self._drain_reportable_events()
+        if events:
+            done_fields["node_events"] = events
+            self.events_shipped += len(events)
+        done = pickle.dumps(done_fields, protocol=5)
         addr = payload.get("driver_addr")
+        if addr:
+            self._note_owner(tuple(addr), payload["driver_id"])
         return (done, oid_bins, tuple(addr) if addr else None,
                 payload["driver_id"])
 
@@ -620,14 +779,19 @@ class NodeDaemon:
                 inline = store.get(oid, timeout=5.0).to_bytes()
             except Exception:  # noqa: BLE001 — racing eviction
                 pass
-        item = pickle.dumps({
+        item_fields = {
             "task_id": bytes(payload["task_id"]),
             "idx": int(idx),
             "oid": oid.binary(),
             "inline": inline,
             "size": size,
             "node_client": self.head.client_id,
-        }, protocol=5)
+        }
+        if tracing._TRACER is not None and payload.get("trace") is not None:
+            # Streaming per-yield reports carry the producer task's
+            # context: the consumer stamps stream.item trace events.
+            item_fields["trace"] = payload["trace"]
+        item = pickle.dumps(item_fields, protocol=5)
         addr = payload.get("driver_addr")
         announce = oid.binary() if inline is None else None
         if announce is not None and addr:
@@ -673,8 +837,12 @@ class NodeDaemon:
                 self._report_q.clear()
             # ("task_done"/"item_done", bytes, addr, drv, announce_oids)
             built = []
+            tail_events = False
             for entry in items:
                 try:
+                    if entry[0] == "events":
+                        tail_events = True
+                        continue
                     if entry[0] == "item":
                         _, payload, idx, oid = entry
                         item, ann, addr, drv = self._build_item(
@@ -770,6 +938,43 @@ class NodeDaemon:
                 except Exception as exc:  # driver gone: results stay
                     log.debug("completion relay to driver %s failed "
                               "(results stay local): %r", driver_id, exc)
+            if tail_events:
+                # Completion batches in this drain already shipped what
+                # they could; anything recorded since goes direct to
+                # the last reported-to driver (best-effort telemetry —
+                # still zero head RPCs).
+                self._flush_tail_events()
+
+    def _drain_reportable_events(self):
+        """Drain task events past the shipping cursor, rendered to the
+        wire tuple shape both shipping paths (piggybacked ``node_events``
+        and the direct ``task_events`` tail flush) unpack. Only the
+        states the cluster view renders ship (RUNNING + terminal);
+        transient PENDING_* bookkeeping stays local. Reporter-thread
+        only: the cursor advances unconditionally."""
+        cursor, fresh = self.worker.task_events.drain_since(
+            self._events_cursor)
+        self._events_cursor = cursor
+        return [(ev.task_id.binary(), ev.state, ev.timestamp, ev.name,
+                 ev.duration) for ev in fresh
+                if not ev.state.startswith("PENDING")]
+
+    def _flush_tail_events(self):
+        with self._seen_lock:
+            owner = self._last_owner
+        if owner is None or owner[0] is None:
+            return
+        events = self._drain_reportable_events()
+        if not events:
+            return
+        blob = pickle.dumps((self.head.client_id, events), protocol=5)
+        try:
+            self.head._peers.call(tuple(owner[0]),
+                                  ("task_events", blob))
+            self.events_shipped += len(events)
+        except Exception as exc:  # noqa: BLE001 — owner gone: telemetry
+            log.debug("tail task-event ship to %s failed (telemetry "
+                      "only): %r", owner[1], exc)
 
     # ----------------------------------------------------------------- drain
     def _on_fn_preship(self, msg: tuple):

@@ -29,6 +29,7 @@ from ray_tpu._private.transport import (
     exc_to_wire,
     wire_to_exc,
 )
+from ray_tpu._private import tracing
 
 PULL_CHUNK = 4 << 20
 PULL_WINDOW = 8  # pipelined chunk requests in flight per direct pull
@@ -87,6 +88,15 @@ class ObjectServer:
                 if kind == "meta":
                     try:
                         raw = self._provider(bytes(msg[1]))
+                        if len(msg) > 2 and tracing._TRACER is not None:
+                            # Traced pull: the requesting side rode its
+                            # context on the meta frame — record the
+                            # serve hop (tracing off = 2-element frame,
+                            # zero extra bytes, zero spans).
+                            tracing.event(
+                                "object.serve",
+                                ctx=tracing.extract(msg[2]),
+                                nbytes=len(raw))
                         conn.send(("ok", len(raw)))
                     except Exception as exc:  # not owned here
                         log.debug("meta miss (object not owned here): "
@@ -237,7 +247,9 @@ class PeerPool:
         """Windowed pull protocol on one locked lane. Raises on any
         condition that leaves the reply stream unusable (unread
         in-flight replies, short data) — the caller retires the lane."""
-        conn.send(("meta", oid_bin))
+        trace_wire = tracing.inject()  # ambient ctx; None when off
+        conn.send(("meta", oid_bin) if trace_wire is None
+                  else ("meta", oid_bin, trace_wire))
         status, size = conn.recv()
         if status != "ok" or size is None:
             return None

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private.log import get_logger
 from ray_tpu._private.object_server import PeerUnreachableError
+from ray_tpu._private import tracing
 from ray_tpu._private.serialization import SerializedObject
 from ray_tpu.exceptions import ActorDiedError, RayTaskError
 
@@ -233,14 +234,17 @@ class RemoteActorRuntime:
         self.router.register_external(task_id, self.node_client)
         with self._lock:
             self._inflight[task_id] = list(return_ids)
+        trace_wire = tracing.inject()  # caller thread's ambient context
+        if trace_wire is not None:
+            tracing.register_task(task_id.binary(), trace_wire)
         self.worker.task_events.record(task_id, "PENDING_ACTOR_TASK",
                                        name=name)
         self._dispatch.submit(self._do_submit, task_id, method_name,
-                              args, kwargs, return_ids, name)
+                              args, kwargs, return_ids, name, trace_wire)
         return refs
 
     def _do_submit(self, task_id: TaskID, method_name: str, args, kwargs,
-                   return_ids, name: str):
+                   return_ids, name: str, trace_wire=None):
         if self.dead:
             self._fail(return_ids, ActorDiedError(
                 self.actor_id, self.death_cause or "actor is dead"))
@@ -249,7 +253,7 @@ class RemoteActorRuntime:
             wired_args = [wire_arg(self.router, a) for a in args]
             wired_kwargs = {k: wire_arg(self.router, v)
                             for k, v in kwargs.items()}
-            payload = pickle.dumps({
+            fields = {
                 "op": "submit",
                 "actor_id": self.actor_id.binary(),
                 "incarnation": self.incarnation,
@@ -263,7 +267,12 @@ class RemoteActorRuntime:
                 # Owner identity: the host resolves arg locations and
                 # pushes completion reports owner-direct with this.
                 "driver_addr": list(self.head._object_server.address),
-            }, protocol=5)
+            }
+            if trace_wire is not None:
+                # actor_op hop carries the caller's trace context: the
+                # hosting node's task-event bridge emits its spans.
+                fields["trace"] = trace_wire
+            payload = pickle.dumps(fields, protocol=5)
             self._node_call(payload)
         except BaseException as exc:  # noqa: BLE001 — dispatch boundary
             if isinstance(exc, (ActorDiedError, RayTaskError)):
@@ -459,9 +468,13 @@ class ActorHost:
     local restarts) and serves create/submit/kill from remote drivers,
     direct or head-relayed."""
 
-    def __init__(self, worker, head):
+    def __init__(self, worker, head, on_owner_seen=None):
         self.worker = worker
         self.head = head
+        # Hosting daemon's hook: actor ops carry the calling driver's
+        # report address too, so actor-only nodes still learn where
+        # their tail task events ship.
+        self._on_owner_seen = on_owner_seen
         self._lock = threading.Lock()
         self._queues: Dict[bytes, "queue.Queue"] = {}
         self._owners: Dict[bytes, str] = {}     # actor_bin -> driver client
@@ -490,6 +503,9 @@ class ActorHost:
         return self.handle(pickle.loads(bytes(event[1])))
 
     def handle(self, p: dict):
+        if self._on_owner_seen is not None and p.get("driver_addr"):
+            self._on_owner_seen(tuple(p["driver_addr"]),
+                                p.get("driver_id"))
         op = p["op"]
         if op == "create":
             return self._create(p)
@@ -576,6 +592,10 @@ class ActorHost:
                          for a in p["args"])
             kwargs = {k: unwire_arg(self.worker, self.head, v, owner)
                       for k, v in p["kwargs"].items()}
+            if tracing._TRACER is not None and p.get("trace") is not None:
+                # The caller's context rode the actor_op payload: this
+                # node's task-event bridge emits the call's spans.
+                tracing.register_task(bytes(p["task_id"]), p["trace"])
             refs = runtime.submit_prepared(
                 p["method"], args, kwargs, return_ids, p["name"])
             self._pin(refs)

@@ -66,6 +66,7 @@ from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.log import get_logger
 from ray_tpu._private.object_server import PeerUnreachableError
 from ray_tpu._private.scheduler import TaskSpec, _collect_refs
+from ray_tpu._private import tracing
 
 log = get_logger(__name__)
 from ray_tpu.exceptions import (
@@ -110,6 +111,11 @@ class RemoteRouter:
         # resolving after the node exits.
         self.head._object_server.handlers["object_offload"] = \
             self._on_object_offload
+        # Node task-event shipping (observability): events ride the
+        # task_done payloads; TAIL events (terminal records that raced
+        # past the last completion flush) arrive on this side channel.
+        self.head._object_server.handlers["task_events"] = \
+            self._on_task_events
         self.lineage: Dict[TaskID, TaskSpec] = {}
         self._done: Dict[TaskID, threading.Event] = {}
         self._done_cbs: Dict[TaskID, List[Callable[[], None]]] = {}
@@ -438,6 +444,11 @@ class RemoteRouter:
                 with self._lock:
                     self._unmet_hints.append((dict(demand),
                                               time.monotonic()))
+                # Cold-start chain: the request that exposed the
+                # capacity gap parks its trace context so the
+                # autoscaler's launch (and the launched node's init /
+                # head join) lands in the same trace.
+                tracing.stash_cold_start()
                 from ray_tpu.exceptions import PlacementInfeasibleError
 
                 raise PlacementInfeasibleError(
@@ -966,6 +977,11 @@ class RemoteRouter:
             # yield loop, resumed by this driver's consumption acks.
             payload["streaming"] = True
             payload["backpressure"] = int(spec.backpressure)
+        if spec.trace is not None and tracing._TRACER is not None:
+            # Trace context rides the task dict (tracing off = key
+            # absent = zero extra wire bytes); the node daemon's
+            # task-event bridge emits accept/queue/exec spans under it.
+            payload["trace"] = tuple(spec.trace)
         if pending_refs:
             # The node gates THESE refs on its wait plane; ordinary
             # owner-resolvable pull-refs stay on its bounded pull pools.
@@ -1181,7 +1197,30 @@ class RemoteRouter:
         if first_exc is not None:
             for ctid in children:
                 self._fail_downstream(ctid, first_exc)
+        # Node task events ride home on this report (zero new head
+        # RPCs): merge them so util.state.list_tasks() sees cluster
+        # tasks, and stamp the driver-side completion into the trace.
+        shipped = payload.get("node_events")
+        if shipped:
+            node_client = payload["node_client"]
+            self.worker.task_events.ingest(
+                (TaskID(bytes(tb)), state, ts, name, dur, node_client)
+                for tb, state, ts, name, dur in shipped)
+        if tracing._TRACER is not None:
+            ctx = tracing.task_context(bytes(payload["task_id"]))
+            if ctx is not None:
+                tracing.event("task.done", ctx=ctx,
+                              node=payload["node_client"],
+                              error=str(first_exc is not None))
         return None
+
+    def _on_task_events(self, msg: tuple):
+        """Tail task events from a node (no completion report left to
+        ride): merge them into the driver's state-API ring."""
+        node_client, events = pickle.loads(bytes(msg[1]))
+        return self.worker.task_events.ingest(
+            (TaskID(bytes(tb)), state, ts, name, dur, node_client)
+            for tb, state, ts, name, dur in events)
 
     # --------------------------------------------------------------- drain
     def _on_object_offload(self, msg: tuple):
@@ -1310,6 +1349,12 @@ class RemoteRouter:
                 self._oid_sizes[oid.binary()] = size
             stream.known_remote_sizes[int(payload["idx"])] = size
         stream.commit(int(payload["idx"]))
+        if tracing._TRACER is not None:
+            ctx = tracing.extract(payload.get("trace"))
+            if ctx is not None:
+                tracing.event("stream.item", ctx=ctx,
+                              idx=int(payload["idx"]),
+                              node=payload["node_client"])
         self.owner_directory.publish_many([oid.binary()])
         return None
 

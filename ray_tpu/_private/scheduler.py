@@ -23,6 +23,7 @@ from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.log import get_logger
 from ray_tpu._private.task_events import TaskEventBuffer
+from ray_tpu._private import tracing
 
 log = get_logger(__name__)
 from ray_tpu.exceptions import (
@@ -53,6 +54,10 @@ class TaskSpec:
     # producer pausing at `backpressure` committed-but-unconsumed items.
     streaming: bool = False
     backpressure: int = 0
+    # Trace context wire form ((trace_id, span_id) or None): captured
+    # from the submitting thread's ambient context when tracing is
+    # armed; rides task payloads across the wire (tracing.py).
+    trace: Any = None
     # Filled by the scheduler:
     attempt: int = 0
 
@@ -724,11 +729,15 @@ class LocalScheduler:
             try:
                 env_fields = (dict(spec.runtime_env)
                               if spec.runtime_env is not None else None)
-                w.request(
-                    ("task", digest, fn_bytes, payload, ret_keys,
-                     spec.num_returns, spec.task_id.binary(), spec.name,
-                     env_fields),
-                    cancel_event=cancelled_event)
+                msg = ("task", digest, fn_bytes, payload, ret_keys,
+                       spec.num_returns, spec.task_id.binary(), spec.name,
+                       env_fields)
+                if spec.trace is not None and tracing._TRACER is not None:
+                    # Optional trailing field (tracing off = message
+                    # unchanged): the worker process records its own
+                    # exec span under the task's trace context.
+                    msg = msg + (tuple(spec.trace),)
+                w.request(msg, cancel_event=cancelled_event)
             finally:
                 with self._lock:
                     self._proc_running.pop(spec.task_id, None)

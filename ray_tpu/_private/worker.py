@@ -30,6 +30,7 @@ from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.scheduler import LocalScheduler, ResourcePool, TaskSpec
 from ray_tpu._private.serialization import SerializationContext
 from ray_tpu._private.task_events import TaskEventBuffer
+from ray_tpu._private import tracing
 from ray_tpu.exceptions import RayTaskError, RayTpuError
 
 class _TaskContext:
@@ -399,6 +400,23 @@ class Worker:
             tempfile.gettempdir(), "ray_tpu",
             f"session_{uuid.uuid4().hex[:12]}")
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # Distributed tracing (RAY_TPU_TRACE): arm the per-process span
+        # ring, and point spawned worker processes (env inherits) at
+        # this session's trace dir so their spans surface through our
+        # trace_dump. One `is None` branch everywhere when off.
+        if os.environ.get(tracing.ENV_VAR):
+            # Always re-point at OUR session (a daemon inherits the
+            # launching driver's env): each runtime's child workers
+            # spill locally, surfaced by this process's trace_dump.
+            os.environ[tracing.ENV_DIR] = os.path.join(
+                self.session_dir, "traces")
+        tracer = tracing.install_from_env(component="driver")
+        if tracer is not None and self.head_client is not None:
+            # Node-qualify this process — and, via the env, its spawned
+            # worker processes — so assembled views keep same-pid
+            # processes on different hosts distinct.
+            tracer.set_identity(node=self.head_client.client_id)
+            os.environ[tracing.ENV_NODE] = self.head_client.client_id
         # session_latest convenience link (the `logs` CLI default target).
         link = os.path.join(os.path.dirname(self.session_dir),
                             "session_latest")
@@ -671,6 +689,14 @@ class Worker:
         # Pin args that are refs for the duration of the task (submitted-refs
         # in the reference's refcount protocol).
         from ray_tpu._private.scheduler import _collect_refs
+
+        if tracing._TRACER is not None and spec.trace is None:
+            # Capture the submitting thread's ambient context: local
+            # execution bridges spans off task events; routed execution
+            # ships it inside the task payload.
+            spec.trace = tracing.inject()
+            if spec.trace is not None:
+                tracing.register_task(spec.task_id.binary(), spec.trace)
 
         dep_refs = _collect_refs(spec.args, spec.kwargs)
         for ref in dep_refs:

@@ -20,6 +20,8 @@ import sys
 import traceback
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import tracing
+
 
 class _ShmRef:
     """Marker for an argument stored in the shm object store."""
@@ -425,7 +427,8 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 _reply(("ok", os.getpid()))
             elif kind == "task":
                 (_, digest, fn_bytes, payload, return_keys, num_returns,
-                 task_id_bin, name, env_fields) = msg
+                 task_id_bin, name, env_fields) = msg[:9]
+                trace_wire = msg[9] if len(msg) > 9 else None
                 fn = fn_cache.get(digest)
                 if fn is None:
                     fn = cloudpickle.loads(_fetch_blob(store, fn_bytes))
@@ -433,6 +436,9 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                 args, kwargs = _load_payload(store, ctx,
                                              _fetch_blob(store, payload))
                 _set_task_ctx(task_id_bin, name)
+                span = tracing.begin(
+                    "worker.exec", parent=tracing.extract(trace_wire),
+                    task=name) if trace_wire is not None else None
                 try:
                     if env_fields:
                         renv = _cached_runtime_env(env_fields)
@@ -440,7 +446,12 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
                             result = fn(*args, **kwargs)
                     else:
                         result = fn(*args, **kwargs)
+                except BaseException:
+                    tracing.finish(span, status="error")
+                    span = None
+                    raise
                 finally:
+                    tracing.finish(span)
                     _set_task_ctx(None, None)
                 _store_outputs(store, ctx, return_keys, result, num_returns)
                 _reply(("ok", None))
@@ -675,6 +686,10 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--max-msg", type=int, default=4 << 20)
     args = ap.parse_args(argv)
+    # Tracing arms from the inherited environment; worker processes have
+    # no dialable trace_dump server, so finished spans SPILL to the
+    # hosting runtime's RAY_TPU_TRACE_DIR (merged by its trace_dump).
+    tracing.install_from_env(component="worker", spill=True)
     worker_loop(args.store, args.req_id, args.rep_id, args.worker_id,
                 args.max_msg, args.api_req_id, args.api_rep_id,
                 args.ack_id)

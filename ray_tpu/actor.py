@@ -22,6 +22,7 @@ from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private.log import get_logger
 from ray_tpu._private.worker import ObjectRef, auto_init, global_worker
+from ray_tpu._private import tracing
 
 from ray_tpu.exceptions import (
     ActorDiedError,
@@ -1020,6 +1021,10 @@ class _ActorRuntime:
                                  self.death_cause or "actor is dead")
             worker.store.put_error(return_ids[0], err)
             return gen
+        if tracing._TRACER is not None:
+            # Ambient caller context → this call's spans (queue/exec
+            # via the task-event bridge on the executing runtime).
+            tracing.register_task(task_id.binary(), tracing.inject())
         worker.task_events.record(task_id, "PENDING_ACTOR_TASK", name=name)
         call = _MethodCall(
             method_name, args, kwargs, return_ids, name, streaming=True,
@@ -1042,6 +1047,9 @@ class _ActorRuntime:
             for oid in return_ids:
                 worker.store.put_error(oid, err)
             return refs
+        if tracing._TRACER is not None:
+            tracing.register_task(return_ids[0].task_id().binary(),
+                                  tracing.inject())
         worker.task_events.record(return_ids[0].task_id(),
                                   "PENDING_ACTOR_TASK", name=name)
         call = _MethodCall(method_name, args, kwargs, return_ids, name)

@@ -319,6 +319,16 @@ class LocalSubprocessProvider(NodeProvider):
         if self.worker_mode:
             cmd += ["--worker-mode", self.worker_mode]
         env = dict(self.env if self.env is not None else os.environ)
+        from ray_tpu._private import tracing
+
+        ctx = tracing.current_context()
+        if ctx is not None:
+            # Traced cold start (the launch span is ambient on this
+            # thread): the daemon parents its node.init span — and the
+            # head its node.join record — to this context.
+            env[tracing.ENV_PARENT] = tracing.encode_cold_start_parent(ctx)
+        else:
+            env.pop(tracing.ENV_PARENT, None)
         return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
                                 env=env)
 
@@ -477,11 +487,21 @@ class ClusterAutoscaler:
         return self._counts().get(name, 0)
 
     def _launch(self, t: NodeTypeConfig) -> bool:
+        from ray_tpu._private import tracing
         from ray_tpu.exceptions import NodeLaunchFailedError
 
         if self._counts().get(t.name, 0) >= t.max_workers:
             return False
         t_start = time.monotonic()
+        # Traced cold start: adopt the context parked by the request /
+        # reconcile thread that exposed the capacity gap — the launch
+        # becomes a span in ITS trace, and the provider forwards the
+        # context to the spawned daemon via RAY_TPU_TRACE_PARENT.
+        cold = tracing.take_cold_start_timed()
+        cold_parent, cold_deadline = cold if cold else (None, 0.0)
+        span = tracing.begin("node.launch", parent=cold_parent,
+                             node_type=t.name) \
+            if tracing.active() else None
         try:
             handle = self.provider.launch(t)
         except NodeLaunchFailedError as exc:
@@ -495,12 +515,26 @@ class ClusterAutoscaler:
                     "error": repr(exc)})
             log.warning("node launch for type %r failed typed: %s",
                         t.name, exc)
+            tracing.finish(span, status="error")
+            # Re-park the requesting context WITH its original deadline:
+            # the retried launch on the next tick must land in the SAME
+            # trace (or the assembled cold-start chain loses
+            # launch/join/init whenever the first attempt fails), but
+            # repeated failures must not keep resetting the expiry.
+            if cold_parent is not None:
+                tracing.stash_cold_start(cold_parent,
+                                         deadline=cold_deadline)
             return False
         except Exception:  # noqa: BLE001 — provider failure: retry later
+            tracing.finish(span, status="error")
+            if cold_parent is not None:
+                tracing.stash_cold_start(cold_parent,
+                                         deadline=cold_deadline)
             return False
         now = time.monotonic()
         client_id = handle.get("client_id", "") \
             if isinstance(handle, dict) else ""
+        tracing.finish(span, client_id=client_id)
         with self._lock:
             self._managed.append(_Managed(t.name, handle, client_id,
                                           launched_at=now))

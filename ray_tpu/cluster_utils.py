@@ -117,6 +117,7 @@ class Cluster:
                 max_retries=spec.max_retries,
                 retry_exceptions=spec.retry_exceptions,
                 scheduling_strategy=spec.scheduling_strategy,
+                trace=spec.trace,
                 attempt=spec.attempt + 1)
             self.submit(retry)
         else:
@@ -213,6 +214,7 @@ class Cluster:
                 max_retries=spec.max_retries,
                 retry_exceptions=spec.retry_exceptions,
                 scheduling_strategy=spec.scheduling_strategy,
+                trace=spec.trace,
                 attempt=spec.attempt)
             self.submit(retry)
             return True

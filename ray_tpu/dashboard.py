@@ -22,6 +22,12 @@ Endpoints:
   steady-state RPC counts + FT-log appends (the O(membership)-not-
   O(objects) flatness observable) and this runtime's owner/resolver
   counters
+- ``GET /api/traces``   distributed-tracing index (every trace any
+  process holds spans for); ``?trace_id=`` returns the assembled
+  cluster-wide trace, ``&view=waterfall`` the per-request waterfall
+  rows (RAY_TPU_TRACE must be armed for spans to exist)
+- ``GET /metrics``      cluster Prometheus scrape assembled driver-side
+  (this registry + every live node's, tagged node/component)
 """
 
 from __future__ import annotations
@@ -222,6 +228,27 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = json.dumps(autoscaler_summary(),
                                      default=str).encode()
                 ctype = "application/json"
+            elif self.path.startswith("/api/traces"):
+                from urllib.parse import parse_qs, urlparse
+
+                from ray_tpu.util.state import (
+                    trace_summary,
+                    trace_waterfall,
+                )
+
+                qs = parse_qs(urlparse(self.path).query)
+                tid = qs.get("trace_id", [None])[0]
+                if tid and qs.get("view", [""])[0] == "waterfall":
+                    body = trace_waterfall(tid)
+                else:
+                    body = trace_summary(tid)
+                payload = json.dumps(body, default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/metrics"):
+                from ray_tpu.util.state import cluster_metrics
+
+                payload = cluster_metrics().encode()
+                ctype = "text/plain; version=0.0.4"
             else:
                 payload = _PAGE.encode()
                 ctype = "text/html"

@@ -45,6 +45,10 @@ BOOTSTRAP_ENV_FLAGS: Set[str] = {
     "RAY_TPU_SANITIZE",          # sanitizer arming — must work standalone
     "RAY_TPU_SANITIZE_MODE",     # sanitizer raise-vs-warn
     "RAY_TPU_CHAOS",             # chaos arming — inherited by children
+    "RAY_TPU_TRACE",             # tracing arming — inherited by children
+    "RAY_TPU_TRACE_DIR",         # span spill dir for worker processes
+    "RAY_TPU_TRACE_PARENT",      # cold-start trace ctx for launched nodes
+    "RAY_TPU_TRACE_NODE",        # node identity for spawned processes' spans
 }
 
 _FLAG_RE = re.compile(r"RAY_TPU_[A-Z0-9_]+")

@@ -47,28 +47,52 @@ class LLMServer:
     ``RequestSheddedError`` instead of timing everyone out.
     """
 
+    # Declarative marker for serve handles: deployments of this class
+    # consume LLM request dicts, so a traced handle reshapes the
+    # request payload to carry its context (no class-identity probing
+    # or llm imports in the serve layer).
+    _consumes_llm_requests = True
+
     def __init__(self, engine_config: Optional[EngineConfig] = None,
                  params: Optional[dict] = None,
                  warm_prefix: Optional[list] = None):
         import time as _time
 
+        from ray_tpu._private import tracing
+
         self.init_started_monotonic = _time.monotonic()
         self.first_token_monotonic: Optional[float] = None
         self.warmed_prefix_tokens = 0
-        self.engine = InferenceEngine(engine_config, params=params)
-        if warm_prefix:
-            # Prefix-cache warming (cold-start attack): prefill the
-            # shared prompt ONCE at replica start, so it registers as
-            # COW shared blocks before the first request — the first
-            # same-prefix request computes only its unique tail, and
-            # the controller's next prefix_digest poll advertises the
-            # warmed chain to the router (requests route here WITH a
-            # cache hit from token one).
-            tokens = [int(t) for t in warm_prefix]
-            for _ in self.engine.generate(tokens, max_new_tokens=1):
-                pass
-            self.warmed_prefix_tokens = len(tokens)
+        # Cold-start chain: a replica constructed because a traced
+        # request forced a scale-up parents its init span to the
+        # launch context the environment carried here.
+        init_span = tracing.begin(
+            "replica.init", parent=tracing.cold_start_parent(),
+            component="replica") if tracing.active() else None
+        try:
+            self.engine = InferenceEngine(engine_config, params=params)
+            if warm_prefix:
+                # Prefix-cache warming (cold-start attack): prefill the
+                # shared prompt ONCE at replica start, so it registers
+                # as COW shared blocks before the first request — the
+                # first same-prefix request computes only its unique
+                # tail, and the controller's next prefix_digest poll
+                # advertises the warmed chain to the router (requests
+                # route here WITH a cache hit from token one).
+                tokens = [int(t) for t in warm_prefix]
+                for _ in self.engine.generate(tokens, max_new_tokens=1):
+                    pass
+                self.warmed_prefix_tokens = len(tokens)
+        except BaseException:
+            # Close the span AND restore the thread-local ambient
+            # context — this worker thread is reused, and a dangling
+            # replica.init context would silently adopt every later
+            # span on it.
+            tracing.finish(init_span, status="error")
+            raise
         self.ready_monotonic = _time.monotonic()
+        tracing.finish(init_span,
+                       warmed_prefix_tokens=self.warmed_prefix_tokens)
 
     def __call__(self, request: Union[Dict[str, Any], list]
                  ) -> Iterator[int]:
@@ -77,6 +101,10 @@ class LLMServer:
             kwargs = {k: request[k] for k in
                       ("max_new_tokens", "eos_token_id", "temperature",
                        "seed", "priority") if k in request}
+            if request.get("_trace") is not None:
+                # Trace context rode the serve request dict: the
+                # engine stamps queue/prefill/decode spans under it.
+                kwargs["trace"] = request["_trace"]
         else:
             prompt, kwargs = request, {}
         # A cancelled stream raises GeneratorExit through here; the

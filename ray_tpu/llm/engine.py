@@ -179,6 +179,12 @@ class InferenceEngine:
         self.num_steps = 0
         self.num_prefill_tokens = 0      # prompt tokens actually computed
         self.num_generated_tokens = 0
+        # Per-request TTFT decomposition records (queue/prefill/decode/
+        # ttft seconds), bounded: stats() serves percentile rollups —
+        # the elastic episode's "where does TTFT live" evidence.
+        from collections import deque as _deque
+
+        self._timings: "_deque" = _deque(maxlen=2048)
         self.engine_id = next(_engine_ids)
         _ENGINES[self.engine_id] = self
 
@@ -276,7 +282,8 @@ class InferenceEngine:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0,
                seed: Optional[int] = None,
-               priority: int = 0) -> Request:
+               priority: int = 0,
+               trace=None) -> Request:
         """Enqueue a request. Past the bounded waitqueue the LOWEST
         priority class loses: either this submit raises
         ``EngineQueueFull`` (a ``RequestSheddedError``) or a worse
@@ -291,6 +298,7 @@ class InferenceEngine:
             eos_token_id=(eos_token_id if eos_token_id is not None
                           else self.config.eos_token_id),
             temperature=temperature, seed=seed, priority=priority)
+        req.trace = trace
         # Reject what can NEVER be served: a completion longer than the
         # model's context window, or one larger than the whole pool.
         # (Prompts over the prefill token budget are FINE — chunked
@@ -327,14 +335,15 @@ class InferenceEngine:
                  temperature: float = 0.0,
                  seed: Optional[int] = None,
                  priority: int = 0,
-                 timeout_s: float = 120.0) -> Iterator[int]:
+                 timeout_s: float = 120.0,
+                 trace=None) -> Iterator[int]:
         """Streaming generator of token ids. Closing it mid-generation
         (``close()`` / GC / a Serve stream cancel) frees the sequence's
         private KV blocks immediately."""
         req = self.submit(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id,
                           temperature=temperature, seed=seed,
-                          priority=priority)
+                          priority=priority, trace=trace)
         try:
             while True:
                 try:
@@ -370,10 +379,73 @@ class InferenceEngine:
                 error: Optional[BaseException] = None):
         self.scheduler.release(req, status, error)
         self._requests.pop(req.seq_id, None)
+        req.t_finish = time.monotonic()
+        self._record_timing(req, status)
         if status in (FAILED, SHED) and error is not None:
             req.output_queue.put((_ERROR, error))
         else:
             req.output_queue.put((_DONE, status))
+
+    def _record_timing(self, req: Request, status: str):
+        """TTFT decomposition record + (when the request carried a trace
+        context) llm.queue / llm.prefill / llm.decode spans with a
+        first_token event — the per-request waterfall's engine rows."""
+        t_end = req.t_finish
+        queue_s = ((req.t_sched - req.t_submit)
+                   if req.t_sched is not None else t_end - req.t_submit)
+        prefill_s = ((req.t_prefill_done - req.t_sched)
+                     if req.t_sched is not None
+                     and req.t_prefill_done is not None else 0.0)
+        decode_s = ((t_end - req.t_prefill_done)
+                    if req.t_prefill_done is not None else 0.0)
+        self._timings.append({
+            "status": status,
+            "queue_s": queue_s,
+            "prefill_s": prefill_s,
+            "decode_s": decode_s,
+            "ttft_s": ((req.t_first_token - req.t_submit)
+                       if req.t_first_token is not None else None),
+            "total_s": t_end - req.t_submit,
+        })
+        from ray_tpu._private import tracing
+
+        t = tracing.tracer()
+        if t is None or req.trace is None:
+            return
+        ctx = tracing.extract(req.trace)
+        if ctx is None:
+            return
+        # Monotonic stamps anchor to the submit wall clock for spans.
+        def wall(mono):
+            return req.wall_submit + (mono - req.t_submit)
+
+        ok = "ok" if status == FINISHED else "error"
+        if req.t_sched is not None:
+            t.emit(ctx.trace_id, tracing._new_id(), ctx.span_id,
+                   "llm.queue", wall(req.t_submit), queue_s,
+                   component="llm", tags={"seq": req.seq_id})
+            if req.t_prefill_done is not None:
+                t.emit(ctx.trace_id, tracing._new_id(), ctx.span_id,
+                       "llm.prefill", wall(req.t_sched), prefill_s,
+                       component="llm",
+                       tags={"seq": req.seq_id,
+                             "cached_tokens": req.cached_prompt_tokens})
+                events = []
+                if req.t_first_token is not None:
+                    events.append([wall(req.t_first_token),
+                                   "first_token"])
+                t.emit(ctx.trace_id, tracing._new_id(), ctx.span_id,
+                       "llm.decode", wall(req.t_prefill_done), decode_s,
+                       status=ok, component="llm",
+                       tags={"seq": req.seq_id,
+                             "tokens": len(req.out_tokens)},
+                       events=events)
+        else:
+            # Never scheduled (shed/cancelled in the waitqueue).
+            t.emit(ctx.trace_id, tracing._new_id(), ctx.span_id,
+                   "llm." + status.lower(), wall(req.t_submit), queue_s,
+                   status=ok, component="llm",
+                   tags={"seq": req.seq_id})
 
     # ----------------------------------------------------------------- step
     def step(self) -> bool:
@@ -451,6 +523,7 @@ class InferenceEngine:
             # concurrent same-prefix request hits them mid-prefill.
             self.cache.register_prefix(r.seq_id, r.prefill_pos)
             if r.prefill_pos >= len(r.prompt):
+                r.t_prefill_done = time.monotonic()
                 completed.append(r)
                 rows.append(i)
         if completed:
@@ -481,6 +554,8 @@ class InferenceEngine:
         and retire sequences that hit EOS / their token budget."""
         for i, req in enumerate(reqs):
             tok = self._sample(req, logits[i])
+            if req.t_first_token is None:
+                req.t_first_token = time.monotonic()
             req.out_tokens.append(tok)
             self.num_generated_tokens += 1
             req.output_queue.put(tok)
@@ -513,10 +588,37 @@ class InferenceEngine:
             "steps": self.num_steps,
             "prefill_tokens": self.num_prefill_tokens,
             "generated_tokens": self.num_generated_tokens,
+            "ttft_decomposition": self.ttft_decomposition(),
         }
         out.update(self.scheduler.stats())
         out.update(self.cache.stats())
         return out
+
+    def ttft_decomposition(self) -> Dict[str, Any]:
+        """Percentile rollup of the per-request timing records: where
+        TTFT lives (queue wait vs prefill vs decode) on this engine."""
+        rows = [r for r in list(self._timings)
+                if r["status"] == FINISHED]
+        if not rows:
+            return {"completed": 0}
+
+        def pct(key, q):
+            vals = sorted(r[key] for r in rows if r[key] is not None)
+            if not vals:
+                return None
+            return vals[min(len(vals) - 1, int(len(vals) * q))]
+
+        return {
+            "completed": len(rows),
+            "queue_p50_s": pct("queue_s", 0.5),
+            "queue_p99_s": pct("queue_s", 0.99),
+            "prefill_p50_s": pct("prefill_s", 0.5),
+            "prefill_p99_s": pct("prefill_s", 0.99),
+            "decode_p50_s": pct("decode_s", 0.5),
+            "decode_p99_s": pct("decode_s", 0.99),
+            "ttft_p50_s": pct("ttft_s", 0.5),
+            "ttft_p99_s": pct("ttft_s", 0.99),
+        }
 
     def wait_idle(self, timeout_s: float = 60.0) -> bool:
         """Block until no work remains (tests/bench convenience)."""

@@ -35,6 +35,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time as _time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -81,6 +82,16 @@ class Request:
         # waitqueue admits better classes first and sheds worse ones.
         self.priority = int(priority)
         self.out_tokens: List[int] = []
+        # TTFT decomposition stamps (monotonic; wall_submit anchors
+        # span timestamps): queue wait = t_sched - t_submit, prefill =
+        # t_prefill_done - t_sched, decode = t_finish - t_prefill_done.
+        self.t_submit = _time.monotonic()
+        self.wall_submit = _time.time()
+        self.t_sched: Optional[float] = None
+        self.t_prefill_done: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        self.trace = None  # tracing wire context ((trace_id, span_id))
         # Prompt tokens whose KV is in the cache (prefix-cache hits at
         # admission + chunks computed so far). The request decodes only
         # once this reaches len(prompt).
@@ -272,6 +283,8 @@ class Scheduler:
             n = min(len(req.prompt) - cached, budget)
             chunks.append((req, cached, n))
             budget -= n
+            if req.t_sched is None:
+                req.t_sched = _time.monotonic()  # queue-wait boundary
             self.running.append(req)
             self.num_admitted += 1
         if parked:

@@ -417,6 +417,7 @@ class ServeController:
         the target, everyone waits on the replica set."""
         import time as _time
 
+        from ray_tpu._private import tracing
         from ray_tpu._private.config import GlobalConfig
         from ray_tpu.exceptions import (
             GetTimeoutError,
@@ -424,40 +425,69 @@ class ServeController:
         )
 
         t0 = time.monotonic()
-        with self._lock:
-            info = self._deployments.get(name)
-            if info is None:
-                raise KeyError(f"no deployment named {name!r}")
-            if info.num_replicas == 0:
-                info.wake_events += 1
-                _record_scale_event(info.scale_events, {
-                    "t_decision": t0, "from": 0, "to": 1,
-                    "reason": "wake"})
-                info.num_replicas = 1
-                info.last_scale_change = t0
-        deadline = t0 + float(GlobalConfig.serve_wake_timeout_s)
-        while time.monotonic() < deadline:
-            try:
-                self._reconcile_once()
-            except PlacementInfeasibleError as exc:  # capacity pending
-                log.debug("wake reconcile retry pending capacity: %r",
-                          exc)
+        # Traced wake: the whole scale-from-zero wait is one span, and
+        # the context parks in the cold-start stash so the autoscaler's
+        # node launch (running on ITS thread) joins this trace.
+        span = tracing.begin("serve.wake", deployment=name) \
+            if tracing.active() else None
+        tracing.stash_cold_start()
+        try:
             with self._lock:
                 info = self._deployments.get(name)
-                size = info.replica_set.size() if info else 0
-            if info is None:
-                raise KeyError(f"no deployment named {name!r}")
-            if size > 0:
+                if info is None:
+                    raise KeyError(f"no deployment named {name!r}")
+                if info.num_replicas == 0:
+                    info.wake_events += 1
+                    _record_scale_event(info.scale_events, {
+                        "t_decision": t0, "from": 0, "to": 1,
+                        "reason": "wake"})
+                    info.num_replicas = 1
+                    info.last_scale_change = t0
+            deadline = t0 + float(GlobalConfig.serve_wake_timeout_s)
+            while time.monotonic() < deadline:
+                try:
+                    self._reconcile_once()
+                except PlacementInfeasibleError as exc:  # capacity pending
+                    log.debug("wake reconcile retry pending capacity: %r",
+                              exc)
                 with self._lock:
-                    info.last_wake_latency_s = time.monotonic() - t0
-                return
-            _time.sleep(0.25)
-        raise GetTimeoutError(
-            f"deployment {name!r} did not wake from zero replicas "
-            f"within {GlobalConfig.serve_wake_timeout_s:.0f}s "
-            f"(RAY_TPU_SERVE_WAKE_TIMEOUT_S)")
+                    info = self._deployments.get(name)
+                    size = info.replica_set.size() if info else 0
+                if info is None:
+                    raise KeyError(f"no deployment named {name!r}")
+                if size > 0:
+                    with self._lock:
+                        info.last_wake_latency_s = time.monotonic() - t0
+                    tracing.finish(span)
+                    # Wake satisfied without a node launch consuming the
+                    # stash: drop it, or the next unrelated launch inside
+                    # the cold-start window adopts this finished trace.
+                    tracing.clear_cold_start(span.ctx if span else None)
+                    return
+                _time.sleep(0.25)
+            raise GetTimeoutError(
+                f"deployment {name!r} did not wake from zero replicas "
+                f"within {GlobalConfig.serve_wake_timeout_s:.0f}s "
+                f"(RAY_TPU_SERVE_WAKE_TIMEOUT_S)")
+        except BaseException:
+            # Any exit but success must close the span AND restore the
+            # thread's ambient context — a dangling wake context would
+            # silently adopt every later span on this reused thread.
+            tracing.finish(span, status="error")
+            tracing.clear_cold_start(span.ctx if span else None)
+            raise
 
     # ------------------------------------------------------------- queries
+    def consumes_llm_requests(self, name: str) -> bool:
+        """Whether the deployment's served class opted into LLM
+        request-dict reshaping (the ``_consumes_llm_requests`` marker)
+        — handles consult this instead of reading the deployment
+        registry directly."""
+        with self._lock:
+            info = self._deployments.get(name)
+        return bool(getattr(getattr(info, "cls", None),
+                            "_consumes_llm_requests", False))
+
     def _replica_set(self, name: str) -> ReplicaSet:
         with self._lock:
             info = self._deployments.get(name)

@@ -317,6 +317,55 @@ class DeploymentHandle:
             priority=self._priority if priority is None else int(priority))
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._private import tracing
+
+        span = None
+        if tracing._TRACER is not None:
+            # Serve entry point: inherit the caller's ambient context
+            # (or root a fresh trace) — the span covers routing, wake
+            # and submission; replica/engine spans parent to it via the
+            # actor payload and the request dict's _trace.
+            span = tracing.begin("serve.request", deployment=self._name,
+                                 method=self._method,
+                                 priority=self._priority)
+            # Only LLMServer deployments get the context injected into
+            # their request dict (it lifts "_trace" into the engine
+            # submit; the engine never sees the dict). Other
+            # deployments' arguments are NEVER reshaped by tracing —
+            # their spans come from the actor-call bridge.
+            if self._targets_llm():
+                if args and isinstance(args[0], dict) \
+                        and "prompt" in args[0]:
+                    args = ({**args[0],
+                             "_trace": tracing.inject(span.ctx)},) \
+                        + args[1:]
+                elif isinstance(kwargs.get("request"), dict) \
+                        and "prompt" in kwargs["request"]:
+                    kwargs = dict(kwargs)
+                    kwargs["request"] = {**kwargs["request"],
+                                         "_trace":
+                                         tracing.inject(span.ctx)}
+        try:
+            result = self._remote_inner(args, kwargs)
+        except BaseException as exc:
+            tracing.finish(span, status="error",
+                           error=type(exc).__name__)
+            raise
+        tracing.finish(span)
+        return result
+
+    def _targets_llm(self) -> bool:
+        """True when this deployment's underlying class consumes LLM
+        request dicts (the ``_consumes_llm_requests`` marker, consulted
+        through the controller). Detached (pickled) handles have no
+        deployment registry — they skip injection; their trace still
+        flows through the actor-op payload."""
+        try:
+            return self._controller.consumes_llm_requests(self._name)
+        except Exception:  # noqa: BLE001 — detached router/thin client
+            return False
+
+    def _remote_inner(self, args, kwargs):
         rs = self._controller._replica_set(self._name)
         # Prefix-aware tier: when any replica has reported a prefix
         # digest (LLM deployments), score replicas by cached-prefix

@@ -24,6 +24,19 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _route(self):
+        from ray_tpu._private import tracing
+
+        if tracing._TRACER is None:
+            self._route_inner()
+            return
+        # HTTP entry point: one trace per proxy request; the handle's
+        # serve.request span (and everything below it) parents here —
+        # look the request up afterwards via /api/traces.
+        with tracing.start_span("http.request", path=self.path,
+                                method=self.command):
+            self._route_inner()
+
+    def _route_inner(self):
         from urllib.parse import parse_qs, unquote, urlparse
 
         parsed = urlparse(self.path)

@@ -144,6 +144,133 @@ def clear_registry():
         _registry.clear()
 
 
+_SAMPLE_RE = None  # compiled lazily (scrape path only)
+
+
+def relabel_prometheus(text: str, extra_tags: Dict[str, str]) -> str:
+    """Re-render Prometheus text with ``extra_tags`` prepended to every
+    sample line (the cluster-scrape aggregator stamps node/component
+    onto each per-process registry). Comment lines pass through."""
+    global _SAMPLE_RE
+    if not extra_tags:
+        return text
+    if _SAMPLE_RE is None:
+        import re
+
+        _SAMPLE_RE = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?( .+)$")
+    prefix = ",".join(f'{k}="{v}"' for k, v in extra_tags.items())
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, tags, value = m.groups()
+        merged = f"{prefix},{tags}" if tags else prefix
+        out.append(f"{name}{{{merged}}}{value}")
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
+def merge_prometheus(parts: List[str]) -> str:
+    """Merge several exposition texts into ONE valid Prometheus blob.
+    The text format allows each metric family's ``# HELP``/``# TYPE``
+    at most once and requires a family's samples to be contiguous;
+    every node exports the same built-in gauges, so a plain
+    concatenation of per-source registries is rejected by a real
+    Prometheus scraper. Groups samples by family (first-seen order,
+    first HELP/TYPE kept)."""
+    help_lines: Dict[str, str] = {}
+    type_lines: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+
+    def family(fam: str) -> List[str]:
+        if fam not in samples:
+            samples[fam] = []
+            order.append(fam)
+        return samples[fam]
+
+    for text in parts:
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                try:
+                    fam = line.split(None, 3)[2]
+                except IndexError:
+                    continue
+                family(fam)
+                target = (help_lines if line.startswith("# HELP ")
+                          else type_lines)
+                target.setdefault(fam, line)
+            elif line.startswith("#"):
+                continue
+            else:
+                family(line.split("{", 1)[0].split(" ", 1)[0]).append(line)
+    out: List[str] = []
+    for fam in order:
+        if fam in help_lines:
+            out.append(help_lines[fam])
+        if fam in type_lines:
+            out.append(type_lines[fam])
+        out.extend(samples[fam])
+    return "\n".join(out) + ("\n" if out else "")
+
+
+_framework = None
+_framework_lock = threading.Lock()
+
+
+def framework_metrics() -> Dict[str, Metric]:
+    """Built-in per-process runtime gauges (reference: the node metrics
+    agent's default series), registered once per process: scheduler
+    backlog, finished-task count, store object count, trace spans
+    recorded. Node daemons refresh them from their heartbeat loop, so
+    every node's scrape always carries series to tag."""
+    global _framework
+    with _framework_lock:
+        if _framework is None:
+            _framework = {
+                "backlog": Gauge(
+                    "ray_tpu_scheduler_backlog",
+                    "Queued + running tasks on this runtime's scheduler"),
+                "tasks_finished": Gauge(
+                    "ray_tpu_tasks_finished",
+                    "Tasks finished by this runtime's scheduler"),
+                "store_objects": Gauge(
+                    "ray_tpu_store_objects",
+                    "Objects resident in this runtime's python store"),
+                "trace_spans": Gauge(
+                    "ray_tpu_trace_spans_recorded",
+                    "Spans recorded by this process's tracer "
+                    "(0 while tracing is off)"),
+            }
+        return _framework
+
+
+def refresh_framework_metrics(worker) -> None:
+    """Refresh the built-in gauges from a live runtime (heartbeat-rate
+    caller; never raises)."""
+    m = framework_metrics()
+    try:
+        m["backlog"].set(float(worker.scheduler.backlog_size()))
+        m["tasks_finished"].set(
+            float(getattr(worker.scheduler, "_num_finished", 0)))
+        m["store_objects"].set(
+            float(len(getattr(worker.store, "_entries", ()))))
+        from ray_tpu._private import tracing
+
+        t = tracing.tracer()
+        m["trace_spans"].set(
+            float(t.spans_recorded if t is not None else 0))
+    except Exception:  # noqa: BLE001 — telemetry must not fail callers
+        pass
+
+
 _server = None
 
 

@@ -16,6 +16,7 @@ class TaskState:
     name: str
     state: str
     duration_s: Optional[float]
+    node: str = ""  # shipping node's client id ("" = this runtime)
 
 
 @dataclass
@@ -77,7 +78,8 @@ def list_tasks(filters: Optional[List] = None,
     out: List[TaskState] = []
     for ev in worker.task_events.list_tasks(limit=limit * 4):
         st = TaskState(task_id=ev.task_id.hex(), name=ev.name,
-                       state=ev.state, duration_s=ev.duration)
+                       state=ev.state, duration_s=ev.duration,
+                       node=ev.extra.get("node", ""))
         if _matches(st, filters):
             out.append(st)
         if len(out) >= limit:
@@ -386,6 +388,211 @@ def summarize_objects() -> Dict[str, Any]:
 def get_timeline() -> List[dict]:
     """Chrome-tracing events (`ray timeline` parity)."""
     return global_worker().task_events.to_chrome_trace()
+
+
+# ------------------------------------------------------------ tracing
+def _pull_live_nodes(direct_msg: tuple, relay_fn) -> List[tuple]:
+    """One payload from every live node, pulled CONCURRENTLY: direct
+    object-server call (``direct_msg``) first, head relay
+    (``relay_fn(client_id)``) as the fallback. Returns ``(node,
+    payload)`` pairs; a node that answers neither way is skipped — its
+    data is simply absent from this assembly, and the concurrent fan-
+    out bounds a UI request's wall time to the slowest single node
+    instead of the sum of every dead dial."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    w = global_worker()
+    hc = w.head_client
+    router = w.remote_router
+    if hc is None:
+        return []
+    nodes = [n for n in (router.nodes(refresh=True)
+                         if router is not None else [])
+             if n.get("alive")]
+    if not nodes:
+        return []
+
+    def fetch(n):
+        addr = n.get("peer_addr")
+        if addr:
+            try:
+                return hc._peers.call((str(addr[0]), int(addr[1])),
+                                      direct_msg)
+            except Exception:  # noqa: BLE001 — NAT/dead dial
+                pass
+        try:
+            return relay_fn(n["client_id"])
+        except Exception:  # noqa: BLE001 — node mid-death: skipped
+            return None
+
+    with ThreadPoolExecutor(
+            max_workers=min(8, len(nodes)),
+            thread_name_prefix="state-node-pull") as pool:
+        results = list(pool.map(fetch, nodes))
+    return [(n, r) for n, r in zip(nodes, results) if r]
+
+
+def collect_trace_spans(trace_id: Optional[str] = None) -> List[dict]:
+    """Cluster-wide span collection (pull-based): this process's ring
+    (+ its worker processes' spilled spans), the head's ring, and every
+    live node's ``trace_dump`` — direct object-server call first, head
+    relay as the fallback. Deduped by span id. Empty when tracing is
+    off everywhere."""
+    from ray_tpu._private import tracing
+
+    spans: List[dict] = list(tracing.local_spans(trace_id))
+    hc = global_worker().head_client
+    if hc is not None:
+        try:
+            spans.extend(hc.trace_dump(trace_id or ""))
+        except Exception:  # noqa: BLE001 — head down: local view only
+            pass
+        for _n, dumped in _pull_live_nodes(
+                ("trace_dump", trace_id or ""),
+                lambda cid: hc.node_trace_dump(cid, trace_id or "")):
+            spans.extend(dict(s) for s in dumped)
+    seen = set()
+    out = []
+    for s in spans:
+        key = (s.get("span_id"), s.get("t0"), s.get("name"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(s)
+    out.sort(key=lambda s: s.get("t0", 0.0))
+    return out
+
+
+def collect_trace_index() -> Dict[str, Dict[str, Any]]:
+    """Cluster-wide per-trace aggregates (the ``/api/traces`` listing):
+    same pull topology as :func:`collect_trace_spans`, but each source
+    ships O(traces) aggregates instead of its full span ring."""
+    from ray_tpu._private import tracing
+
+    merged: Dict[str, Dict[str, Any]] = {}
+
+    def fold(idx):
+        for tid, r in (idx or {}).items():
+            rec = merged.setdefault(tid, {
+                "num_spans": 0, "processes": set(), "components": set(),
+                "first_t0": r.get("first_t0", 0.0), "errors": 0,
+                "root": ""})
+            rec["num_spans"] += int(r.get("num_spans", 0))
+            rec["processes"].update(r.get("pids", ()))
+            rec["components"].update(r.get("components", ()))
+            rec["first_t0"] = min(rec["first_t0"],
+                                  r.get("first_t0", rec["first_t0"]))
+            rec["errors"] += int(r.get("errors", 0))
+            if not rec["root"] and r.get("root"):
+                rec["root"] = r["root"]
+
+    t = tracing.tracer()
+    if t is not None:
+        fold(t.trace_index())
+    hc = global_worker().head_client
+    if hc is not None:
+        try:
+            fold(hc.trace_index())
+        except Exception:  # noqa: BLE001 — head down: local view only
+            pass
+        for _n, idx in _pull_live_nodes(
+                ("trace_dump", "", True),
+                lambda cid: hc.node_trace_index(cid)):
+            fold(idx)
+    return merged
+
+
+def trace_summary(trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """Assembled cluster trace view. With ``trace_id``: every span of
+    that trace (sorted), the distinct processes/components/nodes it
+    crossed, and Chrome-tracing JSON. Without: an index of every trace
+    any process currently holds spans for."""
+    from ray_tpu._private import tracing
+
+    if trace_id is None:
+        traces = collect_trace_index()
+        return {
+            "num_traces": len(traces),
+            "traces": {
+                tid: {
+                    "num_spans": r["num_spans"],
+                    "num_processes": len(r["processes"]),
+                    "components": sorted(r["components"]),
+                    "first_t0": r["first_t0"],
+                    "errors": r["errors"],
+                    "root": r["root"],
+                } for tid, r in traces.items()
+            },
+        }
+    spans = collect_trace_spans(trace_id)
+    # Node-qualified process identity: bare pids collide across hosts.
+    procs = sorted({tracing.process_key(s.get("node", ""),
+                                        s.get("pid", 0)) for s in spans})
+    t0s = [s["t0"] for s in spans]
+    ends = [s["t0"] + s.get("dur", 0.0) for s in spans]
+    return {
+        "trace_id": trace_id,
+        "num_spans": len(spans),
+        "spans": spans,
+        "processes": procs,
+        "num_processes": len(procs),
+        "components": sorted({s.get("component", "")
+                              for s in spans}),
+        "nodes": sorted({s.get("node", "") for s in spans
+                         if s.get("node")}),
+        "errors": sum(1 for s in spans
+                      if s.get("status") == "error"),
+        "wall_span_s": (max(ends) - min(t0s)) if spans else 0.0,
+        "chrome_trace": tracing.chrome_trace(spans),
+    }
+
+
+def trace_waterfall(trace_id: str) -> List[Dict[str, Any]]:
+    """Per-request waterfall rows: spans of one trace sorted by start,
+    with offsets from the trace's first span (the dashboard's
+    per-request view)."""
+    spans = collect_trace_spans(trace_id)
+    if not spans:
+        return []
+    t0 = min(s["t0"] for s in spans)
+    return [{
+        "offset_s": s["t0"] - t0,
+        "dur_s": s.get("dur", 0.0),
+        "name": s["name"],
+        "component": s.get("component", ""),
+        "pid": s.get("pid", 0),
+        "node": s.get("node", ""),
+        "status": s.get("status", "ok"),
+        "span_id": s.get("span_id", ""),
+        "parent_id": s.get("parent_id", ""),
+    } for s in spans]
+
+
+def cluster_metrics() -> str:
+    """One Prometheus text blob for the cluster, assembled from this
+    process's registry plus every live node's ``metrics_dump`` (tagged
+    ``node``/``component`` per source) — the driver-side twin of the
+    head's ``/metrics`` scrape endpoint."""
+    from ray_tpu.util.metrics import (
+        export_prometheus,
+        merge_prometheus,
+        refresh_framework_metrics,
+        relabel_prometheus,
+    )
+
+    w = global_worker()
+    refresh_framework_metrics(w)
+    parts = [relabel_prometheus(
+        export_prometheus(), {"node": "driver", "component": "driver"})]
+    if w.head_client is not None:
+        hc = w.head_client
+        for n, text in _pull_live_nodes(
+                ("metrics_dump",),
+                lambda cid: hc.node_metrics_dump(cid)):
+            parts.append(relabel_prometheus(
+                str(text), {"node": n["client_id"],
+                            "component": "node"}))
+    return merge_prometheus(parts)
 
 
 def _matches(item, filters) -> bool:

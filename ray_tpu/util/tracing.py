@@ -1,0 +1,47 @@
+"""Public surface of the distributed tracing plane (implementation in
+``ray_tpu._private.tracing`` — this mirrors the ``util.chaos`` re-export
+idiom).
+
+Quickstart::
+
+    RAY_TPU_TRACE=1 python my_driver.py     # arms every spawned process
+
+    from ray_tpu.util import tracing
+    with tracing.start_span("my.request") as span:
+        ref = f.remote(x)                   # context rides the wire
+        ray_tpu.get(ref)
+    summary = ray_tpu.util.state.trace_summary(span.ctx.trace_id)
+    ray_tpu.timeline(trace_id=span.ctx.trace_id, filename="trace.json")
+
+Off by default: with ``RAY_TPU_TRACE`` unset every instrumentation
+point is one module-global ``is None`` branch — zero spans, zero extra
+wire bytes (the chaos-slot inertness idiom).
+"""
+
+from ray_tpu._private.tracing import (  # noqa: F401
+    TraceContext,
+    Tracer,
+    active,
+    begin,
+    chrome_trace,
+    current_context,
+    event,
+    extract,
+    finish,
+    inject,
+    install,
+    install_from_env,
+    local_spans,
+    new_trace,
+    start_span,
+    tracer,
+    uninstall,
+    use_context,
+)
+
+__all__ = [
+    "TraceContext", "Tracer", "active", "begin", "chrome_trace",
+    "current_context", "event", "extract", "finish", "inject",
+    "install", "install_from_env", "local_spans", "new_trace",
+    "start_span", "tracer", "uninstall", "use_context",
+]

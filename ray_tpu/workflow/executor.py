@@ -128,6 +128,8 @@ class WorkflowExecutor:
         for k in ("num_cpus", "num_tpus", "num_gpus", "resources"):
             if opts.get(k) is not None:
                 task_opts[k] = opts[k]
+        from ray_tpu._private import tracing
+
         fn = RemoteFunction(node._fn, task_opts)
         args, kwargs = self._resolve_args(cache, node)
         max_retries = int(opts.get("max_retries", 0) or 0)
@@ -137,7 +139,12 @@ class WorkflowExecutor:
         while True:
             attempts += 1
             try:
-                value = ray_tpu.get(fn.remote(*args, **kwargs))
+                # Workflow-step entry point: one span per attempt; the
+                # submitted task inherits it as the ambient context.
+                with tracing.start_span(
+                        "workflow.step", workflow=self.workflow_id,
+                        step=step_id, attempt=attempts):
+                    value = ray_tpu.get(fn.remote(*args, **kwargs))
                 self._last_attempts = attempts
                 if opts.get("catch_exceptions"):
                     return (value, None)

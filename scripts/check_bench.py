@@ -152,6 +152,11 @@ def main(argv=None) -> int:
                     help="comma-separated metric keys that must be present "
                          "in the newest record and hold against the last "
                          "record carrying them")
+    ap.add_argument("--min", action="append", default=[],
+                    metavar="KEY=VALUE", dest="minimums",
+                    help="absolute floor: the newest record must carry "
+                         "KEY with value >= VALUE (e.g. "
+                         "trace_overhead.fanout_ratio=0.95)")
     args = ap.parse_args(argv)
 
     records = sorted(glob.glob(os.path.join(args.dir, "BENCH_pr*.json")),
@@ -169,6 +174,19 @@ def main(argv=None) -> int:
     required = [k.strip() for k in (args.require or "").split(",")
                 if k.strip()]
     regressions += check_required(records, curr, args.threshold, required)
+    cm = _metrics(curr)
+    for spec in args.minimums:
+        key, _, floor = spec.partition("=")
+        try:
+            floor = float(floor)
+        except ValueError:
+            ap.error(f"--min expects KEY=NUMBER, got {spec!r}")
+        if key not in cm:
+            regressions.append(
+                f"--min metric {key!r} missing from the newest record")
+        elif cm[key] < floor:
+            regressions.append(
+                f"{key}: {cm[key]:.4f} < required floor {floor}")
     base = (os.path.basename(prev_path), os.path.basename(curr_path))
     if regressions:
         print(f"bench-gate FAIL ({base[1]} vs {base[0]}, "

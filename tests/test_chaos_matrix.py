@@ -698,6 +698,89 @@ def test_matrix_worker_kill_x_serve_stream_typed_and_recovers():
         ray_tpu.shutdown()
 
 
+def test_matrix_replica_kill_x_traced_stream_assembles_typed():
+    """Cell (replica SIGKILL × traced serve stream): with tracing armed,
+    a mid-stream replica kill must still leave a COMPLETE trace — the
+    kill visible as an error-status span, every span's parent resolving
+    inside the assembled set (no orphans), and the recovery retry's
+    spans landing in the SAME trace. Composes with the PR 8 NodeKiller
+    replay contract (seeded schedule, kills recorded)."""
+    from ray_tpu import serve
+    from ray_tpu._private import tracing
+
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_TRACE"] = "1"
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start()
+    try:
+        assert tracing.active()
+
+        @serve.deployment(name="traced_stream_cell", num_replicas=2)
+        class S:
+            def __call__(self, n):
+                for i in range(n):
+                    time.sleep(0.05)
+                    yield i
+
+        handle = serve.run(S.bind())
+        with tracing.start_span("request") as root:
+            gen = handle.options(stream=True).remote(200)
+            assert next(gen) == 0
+            victim = gen._replica
+            killer = chaos.NodeKiller(
+                [chaos.pid_kill_target("replica",
+                                       lambda: victim._runtime.pid)],
+                seed=5, interval_s=(0.01, 0.02), max_kills=1)
+            with killer:
+                with pytest.raises(Exception) as ei:
+                    with tracing.start_span("stream.consume"):
+                        for _ in range(1000):
+                            next(gen)
+                assert not isinstance(ei.value, StopIteration)
+            assert [k for k in killer.kills if "error" not in k]
+            # Recovery INSIDE the same trace: a fresh stream completes
+            # on the survivor/replacement replica.
+            deadline = time.monotonic() + 15
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                try:
+                    assert list(handle.options(stream=True)
+                                .remote(3)) == [0, 1, 2]
+                    ok = True
+                except Exception:  # noqa: BLE001 — pre-reconcile route
+                    time.sleep(0.2)
+            assert ok, "no surviving replica served after the kill"
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            spans = tracing.local_spans(root.ctx.trace_id)
+            if any(s["status"] == "error" for s in spans):
+                break
+            time.sleep(0.05)
+        names = {s["name"] for s in spans}
+        assert "serve.request" in names
+        # Kill visible: the consume span (typed error surfaced at
+        # next()) and/or the killed call's exec span carry error
+        # status.
+        errors = [s for s in spans if s["status"] == "error"]
+        assert errors, names
+        # Complete-with-typed-error: no orphan spans — every parent
+        # resolves inside the assembled trace.
+        ids = {s["span_id"] for s in spans}
+        orphans = [s for s in spans
+                   if s["parent_id"] and s["parent_id"] not in ids]
+        assert not orphans, orphans
+        # The recovery stream's spans are in the SAME trace, ok-status.
+        ok_requests = [s for s in spans if s["name"] == "serve.request"
+                       and s["status"] == "ok"]
+        assert ok_requests
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        tracing.uninstall()
+        os.environ.pop("RAY_TPU_TRACE", None)
+        os.environ.pop(tracing.ENV_DIR, None)
+
+
 # --------------------------------------------------------------------------
 # Observability: /api/chaos + util.state.chaos_summary.
 # --------------------------------------------------------------------------
