@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: test analyze bench bench-control-plane bench-llm \
 	bench-llm-prefix bench-gate bench-chaos bench-ownership \
-	bench-elastic bench-trace chaos-gate
+	bench-elastic bench-trace bench-flight chaos-gate debug-dump
 
 test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -74,6 +74,28 @@ bench-trace:
 	$(PYTHON) scripts/check_bench.py \
 		--require trace_overhead.fanout_ratio \
 		--min trace_overhead.fanout_ratio=0.95
+
+# Flight-recorder inertness probe: the real-cluster fan-out with the
+# recorder + stack sampler armed in EVERY process, A/B'd in-session by
+# toggling sampling cluster-wide (flight_ctl) — the armed rate must
+# stay >= 0.95x, then the gate requires the committed record to carry
+# the ratio and hold the floor.
+bench-flight:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite flight_overhead
+	$(PYTHON) scripts/check_bench.py \
+		--require flight_overhead.fanout_ratio \
+		--min flight_overhead.fanout_ratio=0.95
+
+# One-command postmortem collection from a live cluster: pulls every
+# process's flight bundle (all-thread stacks, event rings, profile
+# aggregates, metrics/chaos snapshots) into one incident directory.
+# Usage: make debug-dump ADDRESS=host:port  (omit ADDRESS for a local
+# runtime; requires RAY_TPU_FLIGHT=1 / RAY_TPU_PROFILE=1 in the
+# processes being dumped).
+debug-dump:
+	JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.scripts.cli debug \
+		$(if $(ADDRESS),--address $(ADDRESS),) \
+		$(if $(OUTPUT),--output $(OUTPUT),)
 
 # Deterministic chaos slice inside tier-1 time: the seeded fault-
 # injection / NodeKiller / shedding matrix cells (pytest -m chaos,

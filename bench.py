@@ -777,6 +777,57 @@ def bench_trace_overhead(repeats=2):
     return result
 
 
+def bench_flight_overhead(repeats=3):
+    """Config #17: flight-recorder inertness on the REAL cluster plane
+    — the cp_cluster fan-out with the recorder + stack sampler armed
+    in EVERY process (driver, head, node daemon), A/B'd in-session by
+    toggling the sampler cluster-wide (the ``flight_ctl`` wire verb)
+    between alternating fan-outs over the same sockets and warm state.
+    The headline ``fanout_ratio`` = sampler-on rate / sampler-off rate
+    is gated >= 0.95 (`make bench-flight`): always-on profiling must
+    stay ~free. The armed session also pulls one cluster debug_dump
+    as the collection proof (bundle sources + distinct pids), and a
+    ratio below the floor auto-captures a postmortem archive from
+    inside the live session (``maybe_capture_debug``)."""
+    result = {"suite": "flight_overhead"}
+    n = 2000
+    pair_ratios: list = []
+    off_walls: list = []
+    on_walls: list = []
+    proofs: list = []
+    for _ in range(int(repeats)):
+        probe = _run_probe("cp_cluster_flight", n)
+        pair_ratios.extend(probe["pair_ratios"])
+        off_walls.append(probe["off_wall_med_s"])
+        on_walls.append(probe["on_wall_med_s"])
+        proofs.append({k: probe[k] for k in (
+            "driver_samples", "driver_events", "bundle_sources",
+            "bundle_pids") if k in probe})
+        if "debug_bundle" in probe:
+            result["debug_bundle"] = probe["debug_bundle"]
+    off_med = statistics.median(off_walls)
+    on_med = statistics.median(on_walls)
+    result.update({
+        "fanout_tasks": n,
+        "fanout_off_tasks_per_sec": n / off_med,
+        "fanout_on_tasks_per_sec": n / on_med,
+        "fanout_ratio": statistics.median(pair_ratios),
+        "pair_ratios": [round(r, 4) for r in sorted(pair_ratios)],
+        "repeats": repeats,
+        "collection_proof_per_probe": proofs,
+        "timing": ("in-session A/B: sampler-off vs sampler-on "
+                   "fan-outs, order alternated within pairs so "
+                   "linear host drift cancels (12 pairs per probe "
+                   "process, ratio = median per-pair wall ratio); "
+                   "recorder + event ring stay armed BOTH ways in "
+                   "every process — the ratio isolates the sampling "
+                   "thread's cost, the disarmed-entirely case is "
+                   "pinned costless by tests/test_flight.py "
+                   "inertness units"),
+    })
+    return result
+
+
 def bench_workflow(n_steps=200, repeats=3):
     """Config #9: the durable-workflow plane — step commit throughput
     (per-step journal write + output persist on the run path) and
@@ -1552,11 +1603,14 @@ def bench_chaos_slo(n_high=180, n_low=40, max_new=4):
     total = n_high + n_low
     effective_denom = total - len(shed_low)
     success = (len(ok_high) + len(ok_low)) / max(effective_denom, 1)
-    assert success >= 0.99, (
-        f"effective success {success:.3f} < 0.99 "
-        f"(failed={failed}, shed={len(shed_low)})")
-    assert len(ok_high) == n_high, \
-        f"class-0 streams lost under kill: {len(ok_high)}/{n_high}"
+    # SLO gates auto-capture a cluster debug bundle on failure (the
+    # replicas that misbehaved are still alive right here).
+    _slo_assert("chaos_slo", success >= 0.99,
+                f"effective success {success:.3f} < 0.99 "
+                f"(failed={failed}, shed={len(shed_low)})")
+    _slo_assert("chaos_slo", len(ok_high) == n_high,
+                f"class-0 streams lost under kill: "
+                f"{len(ok_high)}/{n_high}")
 
     admission = serve.status()["chaos_llm"]["admission"]
     p99 = ok_high[min(len(ok_high) - 1, int(len(ok_high) * 0.99))]
@@ -1946,13 +2000,16 @@ def bench_elastic_slo(n_low=12, max_new=4):
         total = len(episode_results)
         effective_denom = max(total - shed_low, 1)
         success = (len(ok_high) + ok_low) / effective_denom
-        assert not ref_lost, (
-            f"drain-before-reap violated: typed ref-loss errors "
-            f"surfaced in the episode: {ref_lost}")
-        assert success >= 0.99, (
-            f"effective success {success:.3f} < 0.99 "
-            f"(failed={failed}, shed={shed_low})")
-        assert wake_outcome == "ok", f"wake request: {wake_outcome}"
+        # SLO gates auto-capture a cluster debug bundle on failure
+        # (evidence dies with the episode's teardown otherwise).
+        _slo_assert("elastic_slo", not ref_lost,
+                    f"drain-before-reap violated: typed ref-loss "
+                    f"errors surfaced in the episode: {ref_lost}")
+        _slo_assert("elastic_slo", success >= 0.99,
+                    f"effective success {success:.3f} < 0.99 "
+                    f"(failed={failed}, shed={shed_low})")
+        _slo_assert("elastic_slo", wake_outcome == "ok",
+                    f"wake request: {wake_outcome}")
 
         p99 = ok_high[min(len(ok_high) - 1, int(len(ok_high) * 0.99))]
         p50 = ok_high[len(ok_high) // 2]
@@ -2053,8 +2110,74 @@ def bench_rl_rollout(repeats=6):
         return {"suite": "rl_rollout", "skipped": repr(e)}
 
 
+def maybe_capture_debug(suite: str, ok: bool, out_dir=None):
+    """Flight-recorder auto-capture on a failed SLO gate: when a gated
+    suite misses its floor with a live runtime attached, pull every
+    process's debug bundle into one incident archive BEFORE teardown
+    destroys the evidence. Returns the incident dir (None when the
+    gate passed or no runtime is up)."""
+    if ok:
+        return None
+    import os
+
+    try:
+        import ray_tpu
+        from ray_tpu._private import flight
+
+        if not ray_tpu.is_initialized():
+            return None
+        # Arm at least this process so the archive always carries the
+        # driver's stacks/sections even when the run wasn't armed —
+        # and retro-register the sections whose construction-time
+        # hookups were no-ops while the recorder was off (scheduler
+        # depths, live engines, serve deployments).
+        rec = flight.install(component="driver")
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            rec.add_section("runtime",
+                            global_worker()._flight_section)
+        except Exception:  # noqa: BLE001 — best-effort enrichment
+            pass
+        try:
+            from ray_tpu.llm.engine import _ENGINES
+
+            for eid, eng in list(_ENGINES.items()):
+                rec.add_section(f"llm.engine-{eid}", eng.stats)
+        except Exception:  # noqa: BLE001 — llm plane absent
+            pass
+        try:
+            from ray_tpu import serve
+
+            rec.add_section("serve", serve.status)
+        except Exception:  # noqa: BLE001 — serve plane absent
+            pass
+        incident = ray_tpu.debug_dump(
+            out_dir or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "debug_dumps"))
+        print(f"[bench] {suite}: SLO gate FAILED — debug bundle "
+              f"captured at {incident}", file=sys.stderr)
+        return incident
+    except Exception as e:  # noqa: BLE001 — capture must not mask the gate
+        print(f"[bench] {suite}: debug auto-capture failed: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _slo_assert(suite: str, cond: bool, msg: str):
+    """assert with postmortem: a failed SLO captures the cluster's
+    debug bundles (the processes that misbehaved are still alive
+    HERE), then raises with the archive path appended."""
+    if cond:
+        return
+    incident = maybe_capture_debug(suite, False)
+    raise AssertionError(
+        msg + (f" [debug bundle: {incident}]" if incident else ""))
+
+
 @contextmanager
-def _cluster_probe_session(trace: bool = False):
+def _cluster_probe_session(trace: bool = False, flight: bool = False):
     """One real-cluster probe session shared by the cp_cluster and
     cp_cluster_trace probes: a head + one node daemon as subprocesses,
     a ZERO-CPU driver (every task crosses the framed transport), a
@@ -2064,7 +2187,9 @@ def _cluster_probe_session(trace: bool = False):
     ``(noop, worker)``; owns teardown. ``trace=True`` arms
     RAY_TPU_TRACE in the session AND every spawned process, and scrubs
     it on exit; ``trace=False`` inherits the caller's environment
-    unchanged (the trace_overhead suite arms it there)."""
+    unchanged (the trace_overhead suite arms it there). ``flight=True``
+    does the same for the flight recorder + stack sampler
+    (RAY_TPU_FLIGHT + RAY_TPU_PROFILE — the flight_overhead suite)."""
     import os
     import subprocess
 
@@ -2075,6 +2200,10 @@ def _cluster_probe_session(trace: bool = False):
     if trace:
         env["RAY_TPU_TRACE"] = "1"
         os.environ["RAY_TPU_TRACE"] = "1"
+    if flight:
+        for var in ("RAY_TPU_FLIGHT", "RAY_TPU_PROFILE"):
+            env[var] = "1"
+            os.environ[var] = "1"
     # The head/node subprocesses import ray_tpu by module path.
     repo = os.path.dirname(os.path.abspath(__file__))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -2119,6 +2248,9 @@ def _cluster_probe_session(trace: bool = False):
             p.wait(timeout=5)
         if trace:
             os.environ.pop("RAY_TPU_TRACE", None)
+        if flight:
+            os.environ.pop("RAY_TPU_FLIGHT", None)
+            os.environ.pop("RAY_TPU_PROFILE", None)
 
 
 def _probe_main(args):
@@ -2250,6 +2382,82 @@ def _probe_main(args):
                 "on_wall_med_s": _stats.median(on_walls),
                 "driver_spans": t.spans_recorded if t else 0,
             }
+    elif args.probe == "cp_cluster_flight":
+        # Flight-recorder overhead A/B inside ONE cluster session:
+        # every process armed (RAY_TPU_FLIGHT + RAY_TPU_PROFILE — the
+        # worst case, recorder AND sampler resident everywhere) the
+        # whole time; pairs alternate the stack sampler cluster-wide
+        # OFF vs ON over the same sockets and warm state via the
+        # flight_ctl wire verb. Same rationale as cp_cluster_trace:
+        # separate-process walls swing ±40% on this host and would
+        # gate noise, not sampling cost.
+        import statistics as _stats
+
+        with _cluster_probe_session(flight=True) as (noop, _w):
+            import ray_tpu
+            from ray_tpu._private import flight as _flight
+            from ray_tpu.util.state import (
+                collect_debug_bundles,
+                set_cluster_profiling,
+            )
+
+            assert _flight.active()
+
+            def timed(profiled: bool) -> float:
+                set_cluster_profiling(profiled)
+                t0 = time.perf_counter()
+                refs = [noop.remote(i) for i in range(n)]
+                out = ray_tpu.get(refs, timeout=600)
+                wall_x = time.perf_counter() - t0
+                assert out == list(range(n))
+                return wall_x
+
+            timed(False)  # warm both paths, untimed
+            timed(True)
+            pair_ratios = []
+            off_walls, on_walls = [], []
+            # Alternate the order WITHIN pairs ((off,on), (on,off), …)
+            # so linear host drift inside a pair cancels across pairs
+            # instead of biasing every ratio the same way.
+            for i in range(12):
+                if i % 2 == 0:
+                    a = timed(False)
+                    b = timed(True)
+                else:
+                    b = timed(True)
+                    a = timed(False)
+                off_walls.append(a)
+                on_walls.append(b)
+                pair_ratios.append(a / b)
+            wall = sum(off_walls) + sum(on_walls)
+            ratio_med = _stats.median(pair_ratios)
+            rec = _flight.recorder()
+            # Collection proof riding the overhead probe: one pull
+            # assembles bundles (stacks + events + profile) from every
+            # armed process in the session.
+            bundles = collect_debug_bundles()
+            pids = {b.get("pid") for b in bundles.values()}
+            for b in bundles.values():
+                pids.update(wb.get("pid")
+                            for wb in b.get("workers", []))
+            extra = {
+                "pair_ratios": [round(r, 4) for r in pair_ratios],
+                "ratio_median": ratio_med,
+                "off_wall_med_s": _stats.median(off_walls),
+                "on_wall_med_s": _stats.median(on_walls),
+                "driver_samples": (rec.sampler.samples_taken
+                                   if rec and rec.sampler else 0),
+                "driver_events": rec.events_recorded if rec else 0,
+                "bundle_sources": len(bundles),
+                "bundle_pids": len(pids),
+            }
+            if ratio_med < 0.95:
+                # The gate is about to fail: capture the postmortem
+                # while the session that misbehaved is still alive.
+                incident = maybe_capture_debug(
+                    "flight_overhead", False)
+                if incident:
+                    extra["debug_bundle"] = incident
     elif args.probe == "cp_cluster":
         with _cluster_probe_session() as (noop, w):
             import ray_tpu
@@ -2337,7 +2545,7 @@ def main():
         "chain", "fanout", "actor", "data", "rl", "model", "sharded",
         "control_plane", "workflow", "streaming", "llm_serving",
         "llm_prefix", "chaos_slo", "ownership", "elastic_slo",
-        "trace_overhead"],
+        "trace_overhead", "flight_overhead"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -2366,6 +2574,7 @@ def main():
         "ownership": bench_ownership,
         "elastic_slo": bench_elastic_slo,
         "trace_overhead": bench_trace_overhead,
+        "flight_overhead": bench_flight_overhead,
     }
 
     if args.suite:

@@ -51,6 +51,7 @@ __all__ = [
     "RemoteFunction",
     "announce_object",
     "cancel",
+    "debug_dump",
     "exceptions",
     "get",
     "get_actor",
@@ -90,6 +91,18 @@ def timeline(trace_id=None, filename=None):
             _json.dump(events, f)
         return filename
     return events
+
+
+def debug_dump(out_dir=None):
+    """One-command postmortem collection (flight-recorder plane, armed
+    via ``RAY_TPU_FLIGHT`` / ``RAY_TPU_PROFILE``): pull every live
+    process's debug bundle — all-thread stacks, event rings, profile
+    aggregates, metrics/chaos snapshots, subsystem sections — over the
+    direct object-server plane (head relay fallback) and write one
+    directory-per-incident archive. Returns the incident directory."""
+    from ray_tpu.util.state import cluster_dump
+
+    return cluster_dump(out_dir)
 
 
 def available_resources():

@@ -253,6 +253,68 @@ _D("trace_cold_start_window_s", float, 180.0,
    "their init spans to the launching request's trace; past it the "
    "variable is dropped so later unrelated scale-ups on a long-lived "
    "node aren't misattributed to a finished trace.")
+_D("profile_hz", float, 19.0,
+   "Flight-recorder stack sampler frequency (RAY_TPU_PROFILE arms the "
+   "sampler; the interval is jittered ±50% so periodic work isn't "
+   "phase-locked out of the profile). The default budgets an always-"
+   "on sampler under ~1% of one core at typical control-plane thread "
+   "counts (one sweep over ~40 threads measures ~0.5 ms, and the GIL "
+   "serializes the sweep against user code).")
+_D("profile_max_stacks", int, 2048,
+   "Bound on DISTINCT folded stacks the sampler aggregates; overflow "
+   "counts into stacks_dropped instead of growing memory.")
+_D("flight_event_capacity", int, 4096,
+   "Per-process flight-recorder event ring capacity (state "
+   "transitions, queue depths, lock-hold outliers, GC pauses).")
+_D("flight_dir", str, "",
+   "Directory for flight bundles: watchdog auto-dumps and worker-"
+   "process bundle spills ('' = <session_dir>/flight, injected into "
+   "spawned processes via RAY_TPU_FLIGHT_DIR).")
+_D("flight_gc_ms", float, 20.0,
+   "GC pauses at or above this many milliseconds become gc.pause "
+   "events in the flight ring (gc.callbacks hook; a classic "
+   "invisible source of tail latency).")
+_D("flight_lock_hold_ms", float, 50.0,
+   "Tracked-lock hold time above which the release records a "
+   "lock.hold outlier event in the flight ring.")
+_D("flight_lock_watchdog_s", float, 10.0,
+   "Tracked-lock hold time above which the lock-hold watchdog fires "
+   "an automatic local dump (the observable shape of a deadlock or a "
+   "lock held across blocking I/O).")
+_D("flight_heartbeat_gap_s", float, 30.0,
+   "Gap since the last flight.beat() above which the heartbeat-gap "
+   "watchdog fires an automatic local dump (one fire per gap "
+   "episode; beats resuming re-arm it).")
+_D("flight_loop_lag_s", float, 2.0,
+   "Watchdog-loop wake overshoot above which the event-loop-lag "
+   "watchdog fires: no thread getting scheduled for this long is a "
+   "process-wide stall (GIL hog, swap storm, SIGSTOP).")
+_D("flight_watchdog_period_s", float, 1.0,
+   "Flight watchdog check period (also the event-loop-lag probe's "
+   "expected sleep).")
+_D("flight_dump_min_interval_s", float, 5.0,
+   "Rate limit between watchdog auto-dumps: a flapping watchdog must "
+   "not fill the disk with incident files.")
+_D("flight_spill_period_s", float, 5.0,
+   "Worker-process bundle spill period (jittered ±20%): nothing can "
+   "dial a worker, so its hosting daemon merges these snapshots into "
+   "its own debug_dump answer.")
+_D("flight_spill_max_records", int, 8,
+   "Rotate-at-capacity bound on a worker's bundle spill file: past "
+   "this many snapshot lines the file restarts at the newest window, "
+   "so a long-lived pooled worker spills O(capacity), not O(run). "
+   "Merge reads only the NEWEST snapshot; the short history exists "
+   "for manual forensics on a worker that died mid-incident, so keep "
+   "this small — every line is a full bundle.")
+_D("flight_task_stuck_s", float, 300.0,
+   "An executing task (worker process / executor thread) past this "
+   "bound fires the task-stuck watchdog — a hung worker auto-dumps "
+   "without operator action (diagnostics only, never a kill; one "
+   "fire per task).")
+_D("flight_bundle_stale_s", float, 120.0,
+   "Spilled worker bundles older than this are expired at merge "
+   "time: a file left by an exited or re-leased pooled worker must "
+   "not masquerade as a live process in an assembled incident.")
 _D("serve_wake_timeout_s", float, 30.0,
    "Scale-to-zero wake bound: a request arriving at a deployment with "
    "zero replicas queues while the controller scales it back up, and "

@@ -585,6 +585,29 @@ class HeadClient:
         return self._request(
             ("node_metrics_dump", target_client)) or ""
 
+    def debug_dump(self) -> dict:
+        """The head process's flight bundle (incident assembly input;
+        {} when the head's recorder is disarmed)."""
+        return dict(self._request(("debug_dump",)) or {})
+
+    def node_debug_dump(self, target_client: str) -> dict:
+        """Head-relayed debug_dump from one node (fallback for nodes
+        whose direct server this process cannot dial)."""
+        return dict(self._request(
+            ("node_debug_dump", target_client)) or {})
+
+    def flight_ctl_head(self, on: bool) -> dict:
+        """Pause/resume the HEAD process's stack sampler."""
+        return dict(self._request(
+            ("flight_ctl", "profile", bool(on))) or {})
+
+    def node_flight_ctl(self, target_client: str, on: bool) -> dict:
+        """Head-relayed flight_ctl: pause/resume one node's stack
+        sampler live. Returns the node's {"running": bool} answer
+        ({} when it could not be reached)."""
+        return dict(self._request(
+            ("node_flight_ctl", target_client, bool(on))) or {})
+
     def node_list(self):
         return [dict(n) for n in self._request(("node_list",))]
 
@@ -888,6 +911,13 @@ class HeadClient:
             try:
                 hb.send(msg)
                 self._check(hb.recv())
+                # Feed the flight recorder's heartbeat-gap watchdog: a
+                # wedged daemon stops completing round trips, and the
+                # watchdog auto-dumps what every thread was doing.
+                from ray_tpu._private import flight as _flight
+
+                if _flight._FLIGHT is not None:
+                    _flight.beat("head_link")
             except Exception as exc:  # re-dial until the head returns
                 log.debug("heartbeat failed; re-dialing head: %r", exc)
                 try:
@@ -916,6 +946,12 @@ class HeadClient:
 
     def close(self):
         self._stop.set()
+        # Retire the flight heartbeat feed FIRST: stopping the beat
+        # loop on purpose must not read as a stall ~gap seconds later.
+        from ray_tpu._private import flight as _flight
+
+        if _flight._FLIGHT is not None:
+            _flight.clear_beat("head_link")
         # Wake the flusher and fail anything still queued — callers must
         # not hang on slots nobody will ever serve.
         with self._req_cv:

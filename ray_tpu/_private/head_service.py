@@ -366,6 +366,14 @@ class HeadService:
         from ray_tpu._private import tracing as _tracing
 
         _tracing.install_from_env(component="head")
+        # Flight recorder (RAY_TPU_FLIGHT / RAY_TPU_PROFILE): the head
+        # answers debug_dump for itself and relays node_debug_dump /
+        # node_flight_ctl for nodes a puller cannot dial directly.
+        from ray_tpu._private import flight as _flight
+
+        rec = _flight.install_from_env(component="head")
+        if rec is not None:
+            rec.add_section("head", self._flight_head_section)
         # Cluster metrics scrape plane: a PeerPool for pulling each
         # node's /metrics registry over its direct object server
         # (lazily used by serve_cluster_metrics / the metrics_scrape
@@ -376,6 +384,21 @@ class HeadService:
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
         self._monitor.start()
+
+    def _flight_head_section(self) -> dict:
+        """Head-plane state for the flight bundle: membership and the
+        per-kind RPC profile (the O(membership) flatness observable)."""
+        with self._lock:
+            return {
+                "rpc_counts": dict(self.rpc_counts),
+                "batches_received": self.batches_received,
+                "num_objects": len(self._objects),
+                "clients_alive": sum(
+                    1 for cl in self._clients.values() if cl.alive),
+                "nodes_alive": sum(
+                    1 for cl in self._clients.values()
+                    if cl.is_node and cl.alive),
+            }
 
     # -------------------------------------------------------------- FT/state
     def _restore(self, state_path: str):
@@ -854,6 +877,37 @@ class HeadService:
                 relayed = ("trace_dump", tid, True) \
                     if len(msg) > 3 and msg[3] else ("trace_dump", tid)
                 return self._relay(target_client, relayed, timeout=15.0)
+            if kind == "debug_dump":
+                from ray_tpu._private import flight as _flight
+                from ray_tpu.util.metrics import (
+                    refresh_framework_metrics,
+                )
+
+                # worker=None: the head has no scheduler/store, but
+                # its flight/trace gauges still refresh so the bundle
+                # snapshot is current (the node handler's twin).
+                refresh_framework_metrics(None)
+                return ("ok", _flight.local_bundle() or {})
+            if kind == "node_debug_dump":
+                _, target_client = msg
+                if not self._is_alive(target_client):
+                    return ("ok", {})
+                return self._relay(target_client, ("debug_dump",),
+                                   timeout=30.0)
+            if kind == "flight_ctl":
+                # The head's OWN sampler (it is not a node — nothing
+                # else can toggle it).
+                from ray_tpu._private import flight as _flight
+
+                return ("ok", {"running": bool(
+                    _flight.set_profiling(bool(msg[2])))})
+            if kind == "node_flight_ctl":
+                _, target_client, on = msg
+                if not self._is_alive(target_client):
+                    return ("ok", {})
+                return self._relay(
+                    target_client, ("flight_ctl", "profile", bool(on)),
+                    timeout=15.0)
             if kind == "node_metrics_dump":
                 _, target_client = msg
                 if not self._is_alive(target_client):

@@ -208,6 +208,23 @@ class NodeDaemon:
         self.head._object_server.handlers["metrics_dump"] = \
             self._on_metrics_dump
         self.head.handlers["metrics_dump"] = self._on_metrics_dump
+        # Flight-recorder pull plane (same topology): debug_dump ships
+        # this node's bundle (+ its worker processes' spilled bundles),
+        # flight_ctl toggles the stack sampler live (the bench A/B and
+        # operators arm cluster-wide profiling without restarts).
+        from ray_tpu._private import flight as _flight
+
+        rec = _flight.recorder()
+        if rec is not None:
+            rec.set_identity(component="node", node=self.head.client_id)
+            os.environ[_flight.ENV_NODE] = self.head.client_id
+            rec.add_section("node", self._flight_node_section)
+        self.head._object_server.handlers["debug_dump"] = \
+            self._on_debug_dump
+        self.head.handlers["debug_dump"] = self._on_debug_dump
+        self.head._object_server.handlers["flight_ctl"] = \
+            self._on_flight_ctl
+        self.head.handlers["flight_ctl"] = self._on_flight_ctl
         # Bounded pools replace the old thread-per-pushed-task model:
         # _intake unpacks + prefetches args + submits; _pulls runs the
         # concurrent argument pulls; _reporter ships task_done RPCs
@@ -328,6 +345,46 @@ class NodeDaemon:
 
         refresh_framework_metrics(self.worker)
         return export_prometheus()
+
+    def _on_debug_dump(self, msg: tuple):
+        """This node's flight bundle: all-thread stacks, event ring,
+        profile aggregate, metrics/chaos snapshots, runtime sections —
+        plus the newest spilled bundle from every worker process this
+        daemon hosts (they have no dialable server of their own).
+        ``{}`` when the recorder is disarmed (the puller skips us)."""
+        from ray_tpu._private import flight as _flight
+        from ray_tpu.util.metrics import refresh_framework_metrics
+
+        if not _flight.active():
+            return {}
+        refresh_framework_metrics(self.worker)
+        return _flight.local_bundle(include_dir=True) or {}
+
+    def _on_flight_ctl(self, msg: tuple):
+        """Live flight-recorder control: ("flight_ctl", "profile", 0|1)
+        pauses/resumes this node's stack sampler. Returns a dict (so a
+        successful pause — running False — still reads as a truthy
+        ANSWER, distinguishable from an unreachable node)."""
+        from ray_tpu._private import flight as _flight
+
+        if len(msg) > 2 and msg[1] in ("profile", b"profile"):
+            return {"running": bool(_flight.set_profiling(bool(msg[2])))}
+        return {"running": False}
+
+    def _flight_node_section(self) -> dict:
+        """Node-plane depths for the flight bundle: what this daemon
+        was doing (accept/report/drain state) when the dump landed."""
+        return {
+            "draining": self._draining,
+            "drain_refusals": self.drain_refusals,
+            "drain_transferred": self.drain_transferred,
+            "seen_tasks": len(self._seen_tasks),
+            "report_queue": len(self._report_q),
+            "fn_cache_bytes": self._fn_cache_bytes,
+            "direct_report_batches": self.direct_report_batches,
+            "announce_fallback_oids": self.announce_fallback_oids,
+            "events_shipped": self.events_shipped,
+        }
 
     # -------------------------------------------------------- function cache
     def _register_fn(self, fn_bytes: bytes) -> bytes:

@@ -471,6 +471,12 @@ class LocalScheduler:
                     self._store, spec.args, spec.kwargs)
                 worker_mod._task_context.current_task_id = spec.task_id
                 worker_mod._task_context.task_name = spec.name
+                # Task-stuck watchdog feed (thread execution plane —
+                # the process plane's twin lives in worker_main).
+                from ray_tpu._private import flight as _flight
+
+                if _flight._FLIGHT is not None:
+                    _flight.note_task_started(spec.name)
                 try:
                     renv = spec.runtime_env
                     if renv is not None and (renv.get("pip")
@@ -498,6 +504,8 @@ class LocalScheduler:
                 finally:
                     worker_mod._task_context.current_task_id = None
                     worker_mod._task_context.task_name = None
+                    if _flight._FLIGHT is not None:
+                        _flight.note_task_finished()
                 if not spec.streaming:
                     self._store_outputs(spec, result)
             if self._events:

@@ -417,6 +417,29 @@ class Worker:
             # processes on different hosts distinct.
             tracer.set_identity(node=self.head_client.client_id)
             os.environ[tracing.ENV_NODE] = self.head_client.client_id
+        # Flight recorder (RAY_TPU_FLIGHT / RAY_TPU_PROFILE): same
+        # arming shape as tracing — point spawned worker processes at
+        # this session's flight dir so their spilled bundles surface
+        # through this runtime's debug_dump. An OPERATOR-set
+        # RAY_TPU_FLIGHT_DIR is authoritative and survives; only dirs
+        # a ray_tpu runtime auto-pointed (marked by the _AUTO
+        # sentinel, e.g. a daemon inheriting the launching driver's
+        # session path — wrong host, wrong session) are re-pointed.
+        from ray_tpu._private import flight
+
+        if (os.environ.get(flight.ENV_VAR)
+                or os.environ.get(flight.ENV_PROFILE)):
+            if (not os.environ.get(flight.ENV_DIR)
+                    or os.environ.get(flight.ENV_DIR_AUTO)):
+                os.environ[flight.ENV_DIR] = os.path.join(
+                    self.session_dir, "flight")
+                os.environ[flight.ENV_DIR_AUTO] = "1"
+        rec = flight.install_from_env(component="driver")
+        if rec is not None:
+            rec.dump_dir = os.environ.get(flight.ENV_DIR, rec.dump_dir)
+            if self.head_client is not None:
+                rec.set_identity(node=self.head_client.client_id)
+                os.environ[flight.ENV_NODE] = self.head_client.client_id
         # session_latest convenience link (the `logs` CLI default target).
         link = os.path.join(os.path.dirname(self.session_dir),
                             "session_latest")
@@ -505,6 +528,9 @@ class Worker:
         if _sanitizer.enabled():
             self.sanitizer_watchdog = _sanitizer.StallWatchdog(
                 self.scheduler, self.resource_pool)
+        # Flight-recorder section: scheduler/store depths render into
+        # every local bundle (the "where is this process stuck" data).
+        flight.add_section("runtime", self._flight_section)
         self.memory_monitor = None
         if (self.worker_pool is not None
                 and GlobalConfig.memory_monitor_threshold > 0):
@@ -533,6 +559,26 @@ class Worker:
         self.placement_groups: Dict[Any, Any] = {}
         self._kv: Dict[bytes, bytes] = {}  # internal KV (GCS-KV parity)
         self._kv_lock = threading.Lock()
+
+    def _flight_section(self) -> dict:
+        """Runtime depths for this process's flight bundle: the
+        queue/backlog numbers a postmortem reads first."""
+        s = self.scheduler
+        out = {
+            "backlog": s.backlog_size(),
+            "running": getattr(s, "num_running", lambda: 0)(),
+            "finished": getattr(s, "num_finished", lambda: 0)(),
+            "store_objects": len(getattr(self.store, "_entries", ())),
+            "resources_available": self.resource_pool.available(),
+            "worker_mode": self.worker_mode,
+        }
+        r = self.remote_router
+        if r is not None:
+            out["router"] = {
+                "direct_pushes": getattr(r, "direct_pushes", 0),
+                "relayed_pushes": getattr(r, "relayed_pushes", 0),
+            }
+        return out
 
     # ------------------------------------------------------------------- api
     def current_task_id(self) -> TaskID:

@@ -335,6 +335,17 @@ def worker_loop(store_name: str, req_id: int, rep_id: int,
         worker_mod._task_context.current_task_id = (
             TaskID(task_id_bin) if task_id_bin else None)
         worker_mod._task_context.task_name = name
+        # Feed the flight recorder's task-stuck watchdog: a task still
+        # executing past flight_task_stuck_s auto-dumps this worker's
+        # stacks without operator action (one `is None` branch when
+        # the recorder is disarmed).
+        from ray_tpu._private import flight as _flight
+
+        if _flight._FLIGHT is not None:
+            if task_id_bin:
+                _flight.note_task_started(name or "task")
+            else:
+                _flight.note_task_finished()
 
     # ------------------------------------------------- streaming producers
     # Mux actors receive acks as ("stream_ack", tid_bin, n) REQUESTS on
@@ -690,6 +701,12 @@ def main(argv=None) -> int:
     # no dialable trace_dump server, so finished spans SPILL to the
     # hosting runtime's RAY_TPU_TRACE_DIR (merged by its trace_dump).
     tracing.install_from_env(component="worker", spill=True)
+    # Flight recorder: same shape — bundle snapshots spill periodically
+    # to the hosting runtime's RAY_TPU_FLIGHT_DIR (merged by its
+    # debug_dump), since nothing can dial a worker process directly.
+    from ray_tpu._private import flight
+
+    flight.install_from_env(component="worker", spill=True)
     worker_loop(args.store, args.req_id, args.rep_id, args.worker_id,
                 args.max_msg, args.api_req_id, args.api_rep_id,
                 args.ack_id)

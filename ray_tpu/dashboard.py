@@ -26,6 +26,11 @@ Endpoints:
   process holds spans for); ``?trace_id=`` returns the assembled
   cluster-wide trace, ``&view=waterfall`` the per-request waterfall
   rows (RAY_TPU_TRACE must be armed for spans to exist)
+- ``GET /api/debug``    flight-recorder panel: every live process's
+  debug bundle (all-thread stacks, event rings, profile aggregates,
+  watchdog fires — RAY_TPU_FLIGHT/RAY_TPU_PROFILE must be armed);
+  ``?archive=1`` writes a directory-per-incident archive server-side
+  and returns its path
 - ``GET /metrics``      cluster Prometheus scrape assembled driver-side
   (this registry + every live node's, tagged node/component)
 """
@@ -242,6 +247,23 @@ class _Handler(BaseHTTPRequestHandler):
                     body = trace_waterfall(tid)
                 else:
                     body = trace_summary(tid)
+                payload = json.dumps(body, default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/debug"):
+                from urllib.parse import parse_qs, urlparse
+
+                from ray_tpu.util.state import (
+                    cluster_dump,
+                    collect_debug_bundles,
+                )
+
+                qs = parse_qs(urlparse(self.path).query)
+                if qs.get("archive", [""])[0]:
+                    # ?archive=1 writes the incident directory server-
+                    # side and returns its path (the one-click dump).
+                    body = {"incident_dir": cluster_dump()}
+                else:
+                    body = collect_debug_bundles()
                 payload = json.dumps(body, default=str).encode()
                 ctype = "application/json"
             elif self.path.startswith("/metrics"):

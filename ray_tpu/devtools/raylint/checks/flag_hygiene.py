@@ -49,6 +49,11 @@ BOOTSTRAP_ENV_FLAGS: Set[str] = {
     "RAY_TPU_TRACE_DIR",         # span spill dir for worker processes
     "RAY_TPU_TRACE_PARENT",      # cold-start trace ctx for launched nodes
     "RAY_TPU_TRACE_NODE",        # node identity for spawned processes' spans
+    "RAY_TPU_FLIGHT",            # flight-recorder arming — inherited
+    "RAY_TPU_PROFILE",           # stack-sampler arming — inherited
+    "RAY_TPU_FLIGHT_DIR",        # bundle spill/auto-dump dir for children
+    "RAY_TPU_FLIGHT_DIR_AUTO",   # marks FLIGHT_DIR as runtime-auto-pointed
+    "RAY_TPU_FLIGHT_NODE",       # node identity for spawned processes' bundles
 }
 
 _FLAG_RE = re.compile(r"RAY_TPU_[A-Z0-9_]+")

@@ -187,6 +187,21 @@ class InferenceEngine:
         self._timings: "_deque" = _deque(maxlen=2048)
         self.engine_id = next(_engine_ids)
         _ENGINES[self.engine_id] = self
+        # Flight-recorder section: this engine's waitqueue depth, KV
+        # occupancy, and TTFT decomposition render into every debug
+        # bundle (weak-registered — a GC'd engine stops reporting via
+        # the WeakValueDictionary, and stats() raising on a dead engine
+        # is caught per-section at dump time).
+        from ray_tpu._private import flight as _flight
+
+        if _flight.active():
+            eid = self.engine_id
+
+            def _section(_id=eid):
+                e = _ENGINES.get(_id)
+                return e.stats() if e is not None else {"gone": True}
+
+            _flight.add_section(f"llm.engine-{eid}", _section)
 
     # ------------------------------------------------------ tensor parallel
     @staticmethod

@@ -177,6 +177,38 @@ def cmd_logs(args):
         print(f"  {fname}  ({size} bytes)")
 
 
+def cmd_debug(args):
+    """One-command postmortem collection (reference: `ray stack` +
+    dashboard state dumps): pull every live process's flight bundle
+    and write one directory-per-incident archive. Requires the flight
+    recorder armed (RAY_TPU_FLIGHT=1 / RAY_TPU_PROFILE=1) in the
+    processes being dumped; this process arms itself so its own
+    bundle is always present."""
+    import os
+
+    os.environ.setdefault("RAY_TPU_FLIGHT", "1")
+    import ray_tpu
+
+    kwargs = {"ignore_reinit_error": True}
+    if args.address:
+        kwargs.update(num_cpus=0, num_tpus=0, address=args.address)
+    ray_tpu.init(**kwargs)
+    incident = ray_tpu.debug_dump(args.output)
+    import json as _json
+
+    manifest = {}
+    try:
+        with open(os.path.join(incident, "manifest.json")) as f:
+            manifest = _json.load(f)
+    except OSError:
+        pass
+    print(json.dumps({
+        "incident_dir": incident,
+        "num_processes": manifest.get("num_processes", 0),
+        "sources": sorted(manifest.get("sources", {})),
+    }, indent=2))
+
+
 def cmd_version(args):
     import ray_tpu
 
@@ -224,6 +256,12 @@ def main(argv=None):
     p.add_argument("filename", nargs="?", default=None)
     p.add_argument("--session", default=None)
     p.set_defaults(fn=cmd_logs)
+    p = sub.add_parser("debug")
+    p.add_argument("--address", default=None,
+                   help="head host:port (omit for a local runtime)")
+    p.add_argument("--output", default=None,
+                   help="archive root (default <session>/debug_dumps)")
+    p.set_defaults(fn=cmd_debug)
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     args = parser.parse_args(argv)

@@ -100,6 +100,13 @@ class ServeController:
             target=self._prefix_poll_loop, daemon=True,
             name="serve-prefix-poll")
         self._prefix_thread.start()
+        # Flight-recorder section: deployment/replica state in every
+        # debug bundle (a stalled drain or wedged scale-up is read
+        # straight out of the incident archive).
+        from ray_tpu._private import flight as _flight
+
+        if _flight.active():
+            _flight.add_section("serve", self.status)
 
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, cls: type, init_args, init_kwargs,

@@ -248,13 +248,24 @@ def framework_metrics() -> Dict[str, Metric]:
                     "ray_tpu_trace_spans_recorded",
                     "Spans recorded by this process's tracer "
                     "(0 while tracing is off)"),
+                "watchdog_fires": Gauge(
+                    "ray_tpu_watchdog_fires",
+                    "Watchdog escalations in this process (flight-"
+                    "recorder heartbeat-gap/loop-lag/lock-hold fires "
+                    "plus sanitizer scheduler-stall fires)"),
+                "flight_events": Gauge(
+                    "ray_tpu_flight_events_recorded",
+                    "Events recorded by this process's flight "
+                    "recorder (0 while the recorder is off)"),
             }
         return _framework
 
 
 def refresh_framework_metrics(worker) -> None:
     """Refresh the built-in gauges from a live runtime (heartbeat-rate
-    caller; never raises)."""
+    caller; never raises). Per-gauge-group isolation: a process with
+    no scheduler/store (``worker=None`` — the head service) still
+    refreshes its tracing/flight gauges."""
     m = framework_metrics()
     try:
         m["backlog"].set(float(worker.scheduler.backlog_size()))
@@ -262,11 +273,23 @@ def refresh_framework_metrics(worker) -> None:
             float(getattr(worker.scheduler, "_num_finished", 0)))
         m["store_objects"].set(
             float(len(getattr(worker.store, "_entries", ()))))
+    except Exception:  # noqa: BLE001 — telemetry must not fail callers
+        pass
+    try:
         from ray_tpu._private import tracing
 
         t = tracing.tracer()
         m["trace_spans"].set(
             float(t.spans_recorded if t is not None else 0))
+        from ray_tpu._private import flight
+        from ray_tpu.util import sanitizer
+
+        rec = flight.recorder()
+        m["watchdog_fires"].set(float(
+            (rec.watchdog_fires if rec is not None else 0)
+            + sanitizer.watchdog_fires))
+        m["flight_events"].set(
+            float(rec.events_recorded if rec is not None else 0))
     except Exception:  # noqa: BLE001 — telemetry must not fail callers
         pass
 

@@ -7,6 +7,25 @@ train steps, collectives — complementing the host-side task timeline
 (``ray_tpu.timeline``). ``annotate`` nests named spans into that trace so
 framework phases (a wave, a pipeline stage) are attributable in the
 device view.
+
+Division of labor between the two profilers:
+
+- **This module (device)** — the XLA profiler records what the
+  ACCELERATOR executed: per-op device time, fusion boundaries, HBM
+  traffic, host↔device transfers. Heavyweight capture, bounded
+  windows, explicit ``with profile_trace(...)`` blocks, output is
+  xplane protobufs for TensorBoard's profile plugin.
+- **``_private/flight.py`` (host)** — the flight recorder's sampling
+  profiler records what the PYTHON HOST PLANE was doing: folded
+  wall-clock stacks of every thread (scheduler, transport, GIL hogs),
+  always-on under ``RAY_TPU_PROFILE``, collapsed/speedscope output.
+  A slow step shows up here when the host is the bottleneck and in
+  the xplane trace when the device is.
+
+The two meet in the debug-bundle plane: every ``profile_trace``
+capture registers its logdir with the flight recorder, so a bundle
+(``ray_tpu.debug_dump()``) lists the device-trace artifacts produced
+this session next to the host-side stacks.
 """
 
 from __future__ import annotations
@@ -32,6 +51,12 @@ def profile_trace(logdir: str,
         yield logdir
     finally:
         jax.profiler.stop_trace()
+        # Register the capture with the flight-recorder bundle plane:
+        # a debug bundle lists every device-trace dir this session
+        # produced (no-op while the recorder is disarmed).
+        from ray_tpu._private import flight
+
+        flight.note_artifact(os.path.abspath(logdir))
 
 
 def annotate(name: str):

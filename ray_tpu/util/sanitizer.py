@@ -32,10 +32,14 @@ Violations raise ``SanitizerError`` by default (tests), or log when
 from __future__ import annotations
 
 import os
-import sys
 import threading
 import time
 from typing import Dict, List, Optional
+
+from ray_tpu._private import flight as _flight
+from ray_tpu._private.log import get_logger
+
+log = get_logger("sanitizer")
 
 
 class SanitizerError(AssertionError):
@@ -45,6 +49,11 @@ class SanitizerError(AssertionError):
 _enabled: Optional[bool] = None
 _violations: List[str] = []
 _lock = threading.Lock()
+
+# Stall-watchdog fires observed by THIS process (summed with the
+# flight recorder's watchdog fires into the framework metrics gauge —
+# this counter covers the flight-disarmed case).
+watchdog_fires = 0
 
 
 def enabled() -> bool:
@@ -73,14 +82,17 @@ def clear() -> None:
 
 
 def report(kind: str, message: str, force_warn: bool = False) -> None:
-    full = f"[ray_tpu sanitizer] {kind}: {message}"
+    full = f"{kind}: {message}"
     with _lock:
-        _violations.append(full)
+        _violations.append(f"[ray_tpu sanitizer] {full}")
     if force_warn or os.environ.get(
             "RAY_TPU_SANITIZE_MODE", "raise") == "warn":
-        print(full, file=sys.stderr, flush=True)
+        # RAY_TPU_LOG_LEVEL governs this (satellite of the flight-
+        # recorder PR): a violation an operator chose not to raise on
+        # is still an ERROR-level condition, never a bare print.
+        log.error("%s", full)
     else:
-        raise SanitizerError(full)
+        raise SanitizerError(f"[ray_tpu sanitizer] {full}")
 
 
 _channel_ids = threading.Lock()
@@ -255,8 +267,15 @@ class TrackedLock:
                 lock_order_watcher.on_acquired_failed(self.name)
             else:
                 self._tracked.held = True
-            return ok
-        return self._lock.acquire(blocking, timeout)
+        else:
+            ok = self._lock.acquire(blocking, timeout)
+        # Flight-recorder hold timing (independent of the sanitizer
+        # arming): hold durations feed the lock.hold outlier events
+        # and the lock-hold watchdog's held-too-long scan. Off = one
+        # module-global load + `is None` branch.
+        if ok and _flight._FLIGHT is not None:
+            _flight.note_lock_acquired(self.name)
+        return ok
 
     def release(self) -> None:
         self._lock.release()
@@ -267,6 +286,8 @@ class TrackedLock:
         if getattr(self._tracked, "held", False):
             self._tracked.held = False
             lock_order_watcher.on_release(self.name)
+        if _flight._FLIGHT is not None:
+            _flight.note_lock_released(self.name)
 
     def locked(self) -> bool:
         return self._lock.locked()
@@ -294,30 +315,43 @@ class TrackedRLock(TrackedLock):
         self._depth = threading.local()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        if not enabled():
-            return self._lock.acquire(blocking, timeout)
+        # Depth is tracked whenever either checker could care (the
+        # flight recorder times 0→1 … 1→0 holds even with the
+        # sanitizer off); `watched` remembers whether the 0→1
+        # transition notified the lock-order watcher, so a mid-hold
+        # enable/disable toggle can neither strand nor double-pop a
+        # stack entry.
         d = getattr(self._depth, "n", 0)
         if d == 0:
-            lock_order_watcher.on_acquire(self.name)
+            watched = enabled()
+            if watched:
+                lock_order_watcher.on_acquire(self.name)
             ok = self._lock.acquire(blocking, timeout)
             if not ok:
-                lock_order_watcher.on_acquired_failed(self.name)
+                if watched:
+                    lock_order_watcher.on_acquired_failed(self.name)
                 return ok
+            self._depth.watched = watched
+            if _flight._FLIGHT is not None:
+                _flight.note_lock_acquired(self.name)
         else:
             ok = self._lock.acquire(blocking, timeout)
-        if ok:
-            self._depth.n = d + 1
+            if not ok:
+                return ok
+        self._depth.n = d + 1
         return ok
 
     def release(self) -> None:
         self._lock.release()
-        # Depth (not enabled()) decides the pop — same toggle-safety
-        # contract as TrackedLock.release.
         d = getattr(self._depth, "n", 0)
         if d > 0:
             self._depth.n = d - 1
             if d == 1:
-                lock_order_watcher.on_release(self.name)
+                if getattr(self._depth, "watched", False):
+                    self._depth.watched = False
+                    lock_order_watcher.on_release(self.name)
+                if _flight._FLIGHT is not None:
+                    _flight.note_lock_released(self.name)
 
     def locked(self) -> bool:
         # threading.RLock grows .locked() only in 3.14; emulate it:
@@ -366,8 +400,7 @@ class StallWatchdog:
             try:
                 self._check()
             except Exception as exc:  # watcher must not die
-                print(f"[ray_tpu sanitizer] stall watchdog check "
-                      f"failed: {exc!r}", file=sys.stderr, flush=True)
+                log.warning("stall watchdog check failed: %r", exc)
 
     def _check(self):
         s = self._scheduler
@@ -389,14 +422,26 @@ class StallWatchdog:
                 self._finished_mark = finished
             elif now - self._stalled_since > self.threshold_s:
                 self._stalled_since = None
+                msg = (f"{queued} task(s) queued > {self.threshold_s}s "
+                       f"with nothing running and idle capacity {avail} "
+                       f"— possible host deadlock (dependency cycle or "
+                       f"lost completion)")
+                # Escalate through the flight recorder when armed: the
+                # stall captures an automatic local dump (all-thread
+                # stacks + event ring + scheduler depths) instead of
+                # only logging what was stuck. Exactly ONE counter
+                # takes the fire — the recorder's when armed, this
+                # module's otherwise — because the metrics gauge sums
+                # the two.
+                if _flight._FLIGHT is not None:
+                    _flight.note_watchdog_fire("scheduler-stall", msg)
+                else:
+                    global watchdog_fires
+                    with _lock:
+                        watchdog_fires += 1
                 # force_warn: raising in our own daemon thread would
                 # only kill the watchdog, not surface the error.
-                report(
-                    "scheduler-stall",
-                    f"{queued} task(s) queued > {self.threshold_s}s "
-                    f"with nothing running and idle capacity {avail} — "
-                    f"possible host deadlock (dependency cycle or lost "
-                    f"completion)", force_warn=True)
+                report("scheduler-stall", msg, force_warn=True)
         else:
             self._stalled_since = None
 

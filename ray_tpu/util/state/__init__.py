@@ -595,6 +595,121 @@ def cluster_metrics() -> str:
     return merge_prometheus(parts)
 
 
+# ------------------------------------------------------- flight recorder
+def collect_debug_bundles() -> Dict[str, dict]:
+    """Every live process's flight bundle, keyed by a cluster-unique
+    source name: this driver (plus its own worker processes' spilled
+    bundles), the head, and every live node (each node bundle nests
+    its hosted workers under ``workers``). Pull-based over the same
+    topology as span collection — direct object-server call first,
+    head relay fallback — so steady state costs ZERO head RPCs.
+    Sources with the recorder disarmed are absent."""
+    from ray_tpu._private import flight
+    from ray_tpu.util.metrics import refresh_framework_metrics
+
+    out: Dict[str, dict] = {}
+    try:
+        # Register + refresh the framework gauges so the driver's
+        # bundle carries a current metrics snapshot (daemons refresh
+        # inside their own debug_dump handlers).
+        refresh_framework_metrics(global_worker())
+    except Exception:  # noqa: BLE001 — metrics are best-effort here
+        pass
+    local = flight.local_bundle(include_dir=True)
+    if local:
+        out["driver"] = local
+    hc = global_worker().head_client
+    if hc is not None:
+        try:
+            head_bundle = hc.debug_dump()
+            if head_bundle:
+                out["head"] = head_bundle
+        except Exception:  # noqa: BLE001 — head down: partial incident
+            pass
+        for n, bundle in _pull_live_nodes(
+                ("debug_dump",),
+                lambda cid: hc.node_debug_dump(cid)):
+            if bundle:
+                out[f"node-{n['client_id']}"] = dict(bundle)
+    return out
+
+
+def cluster_dump(out_dir: Optional[str] = None) -> str:
+    """One-command postmortem collection: pull every live process's
+    flight bundle and write ONE directory-per-incident archive —
+    ``<out_dir>/debug-<stamp>-<id>/`` holding one ``<source>.json``
+    per process (worker bundles split out of their daemon's answer as
+    ``<source>.worker-<pid>.json``) plus a ``manifest.json`` index.
+    Returns the incident directory path."""
+    import json
+    import os
+    import time
+    import uuid
+
+    bundles = collect_debug_bundles()
+    root = out_dir or os.path.join(
+        global_worker().session_dir, "debug_dumps")
+    incident = os.path.join(
+        root, f"debug-{time.strftime('%Y%m%d-%H%M%S')}-"
+              f"{uuid.uuid4().hex[:6]}")
+    os.makedirs(incident, exist_ok=True)
+    manifest = {"ts": time.time(), "sources": {}}
+    for source, bundle in sorted(bundles.items()):
+        workers = bundle.pop("workers", []) or []
+        with open(os.path.join(incident, f"{source}.json"), "w") as f:
+            json.dump(bundle, f, indent=1)
+        files = [f"{source}.json"]
+        for wb in workers:
+            wname = f"{source}.worker-{wb.get('pid', 0)}.json"
+            with open(os.path.join(incident, wname), "w") as f:
+                json.dump(wb, f, indent=1)
+            files.append(wname)
+        manifest["sources"][source] = {
+            "files": files,
+            "pid": bundle.get("pid"),
+            "component": bundle.get("component"),
+            "node": bundle.get("node"),
+            "watchdog_fires": bundle.get("watchdog_fires", 0),
+            "num_workers": len(workers),
+        }
+    manifest["num_processes"] = sum(
+        1 + s["num_workers"] for s in manifest["sources"].values())
+    with open(os.path.join(incident, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return incident
+
+
+def set_cluster_profiling(on: bool) -> Dict[str, bool]:
+    """Pause/resume the stack sampler on THIS process, the head, and
+    every live node daemon (the in-session A/B the flight_overhead
+    bench runs, and the operator's live-toggle). Returns {source:
+    running} per REACHED sampler — every answer is a dict on the
+    wire, so a successful pause is distinguishable from an
+    unreachable node (absent from the result). Worker processes are
+    not dialable and keep their samplers running; their cost is
+    bounded by profile_hz either way (the flight_overhead probe uses
+    thread-mode nodes, so its A/B legs carry no hidden worker
+    sampling)."""
+    from ray_tpu._private import flight
+
+    out = {"driver": flight.set_profiling(on)}
+    hc = global_worker().head_client
+    if hc is not None:
+        try:
+            head_state = hc.flight_ctl_head(on)
+            if head_state:
+                out["head"] = bool(head_state.get("running"))
+        except Exception:  # noqa: BLE001 — head down: partial toggle
+            pass
+        for n, state in _pull_live_nodes(
+                ("flight_ctl", "profile", bool(on)),
+                lambda cid: hc.node_flight_ctl(cid, on)):
+            if isinstance(state, dict):
+                out[f"node-{n['client_id']}"] = \
+                    bool(state.get("running"))
+    return out
+
+
 def _matches(item, filters) -> bool:
     if not filters:
         return True
