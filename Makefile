@@ -2,7 +2,8 @@ PYTHON ?= python
 
 .PHONY: test analyze bench bench-control-plane bench-llm \
 	bench-llm-prefix bench-gate bench-chaos bench-ownership \
-	bench-elastic bench-trace bench-flight chaos-gate debug-dump
+	bench-elastic bench-failover bench-trace bench-flight \
+	chaos-gate debug-dump
 
 test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -64,6 +65,17 @@ bench-ownership:
 # elastic_slo.p99_ttft_under_scale is REQUIRED by check_bench.
 bench-elastic:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite elastic_slo
+
+# Head-failover episode: the elastic shape with the PRIMARY HEAD
+# SIGKILLed mid-ramp and a warm standby promoting over the shared
+# state log (epoch fence on the wire); records the measured blackout
+# (first refused head RPC -> first promoted reply), effective success
+# (>= 0.99 asserted, zero ref loss), and post-promotion epoch. One
+# JSON line; head_failover.blackout_s is REQUIRED by check_bench.
+bench-failover:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite head_failover
+	$(PYTHON) scripts/check_bench.py \
+		--require head_failover.blackout_s
 
 # Tracing inertness probe: the real-cluster fan-out with tracing OFF
 # vs ARMED (spans recorded on every hop, context on every wire frame)

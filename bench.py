@@ -2086,6 +2086,328 @@ def bench_elastic_slo(n_low=12, max_new=4):
     return result
 
 
+def bench_head_failover(n_low=8, max_new=4):
+    """Config #15: live head failover under load — head death as a
+    non-event. The PR 12 elastic episode shape (seeded ramp traffic
+    against an autoscaled LLM deployment on REAL autoscaler-launched
+    nodes, wire faults armed on the peer plane) with the control plane
+    itself as the victim: a warm STANDBY head shares the primary's
+    state log, and the seeded NodeKiller SIGKILLs the PRIMARY mid-ramp.
+    The standby promotes (flock fence + epoch bump), every client —
+    driver, serve controller, autoscaler, node daemons — fails over by
+    epoch and re-registers, and in-flight idempotent head RPCs replay
+    across the blackout. Measured:
+
+    - ``head_failover.blackout_s`` (bench-gate REQUIRED): first
+      refused head RPC -> first reply served by the promoted head, as
+      observed by the driver's head client;
+    - effective success rate across the episode, asserted >= 0.99 with
+      ZERO ObjectLostError/OwnerDiedError — the data/task planes ride
+      through the control-plane blackout;
+    - post-promotion control-plane proof: epoch 2 serving, not fenced,
+      membership re-reconciled, and one fresh end-to-end stream.
+    """
+    import os
+    import socket
+    import subprocess
+    import threading
+
+    import jax.numpy as jnp
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # Seeded wire faults on the peer plane for the whole episode.
+    chaos_json = ('{"seed": 15, "delay": 0.05, "delay_ms": 2, '
+                  '"dup": 0.01, "sites": ["peer"]}')
+    env["RAY_TPU_CHAOS"] = chaos_json
+    os.environ["RAY_TPU_CHAOS"] = chaos_json
+    # Production-ish promotion cadence: ~0.6s of missed probes before
+    # the standby takes over (recorded in the result for context).
+    probe_s, misses = 0.3, 2
+    env["RAY_TPU_HEAD_STANDBY_PROBE_PERIOD_S"] = str(probe_s)
+    env["RAY_TPU_HEAD_STANDBY_MISSES_TO_PROMOTE"] = str(misses)
+    token = "benchfailover%08x" % (os.getpid() & 0xFFFFFFFF)
+    env["RAY_TPU_CLUSTER_TOKEN"] = token
+    os.environ["RAY_TPU_CLUSTER_TOKEN"] = token
+
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.autoscaler import (
+        ClusterAutoscaler,
+        LocalSubprocessProvider,
+        NodeTypeConfig,
+    )
+    from ray_tpu.exceptions import (
+        ObjectLostError,
+        OwnerDiedError,
+        RequestSheddedError,
+    )
+    from ray_tpu.llm import EngineConfig
+    from ray_tpu.llm.api import build_llm_app
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.util import chaos as chaos_util
+    from ray_tpu.util import loadgen
+    from ray_tpu._private.config import GlobalConfig
+
+    GlobalConfig.set("serve_wake_timeout_s", 180.0)
+    injector = chaos_util.install_from_env()
+    assert injector is not None
+    procs = []
+    scaler = None
+    state_dir = tempfile.mkdtemp(prefix="ray_tpu_failover_")
+    state = os.path.join(state_dir, "shared_head_state.log")
+    result = {"suite": "head_failover"}
+    try:
+        with socket.socket() as s:  # reserve the standby's port
+            s.bind(("127.0.0.1", 0))
+            standby_port = s.getsockname()[1]
+        primary = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", "0", "--state", state, "--token", token],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(primary)
+        line = primary.stdout.readline()
+        assert "listening" in line, f"head failed to start: {line!r}"
+        address = line.strip().rsplit(" ", 1)[-1]
+        standby = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.head_service",
+             "--port", str(standby_port), "--state", state,
+             "--token", token, "--standby-of", address],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(standby)
+        assert "standing by" in standby.stdout.readline()
+        addresses = f"{address},127.0.0.1:{standby_port}"
+        # Node daemons (and their workers) inherit the standby list.
+        env["RAY_TPU_HEAD_ADDRESSES"] = addresses
+
+        # Zero local CPUs: every replica's {CPU: 1} demand is
+        # infeasible on the driver, so scale-up MUST launch real nodes.
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=addresses)
+        w = ray_tpu._private.worker.global_worker()
+        scaler = ClusterAutoscaler(
+            addresses,
+            [NodeTypeConfig("serve", {"CPU": 2}, min_workers=0,
+                            max_workers=3)],
+            provider=LocalSubprocessProvider(addresses, env=env),
+            idle_timeout_s=30.0, update_interval_s=0.5)
+
+        serve.start()
+        mcfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=1, n_heads=4,
+            n_kv_heads=2, d_ff=64, dtype=jnp.float32)
+        shared_prefix = [1 + ((i * 5) % 120) for i in range(16)]
+        ecfg = EngineConfig(
+            model=mcfg, num_blocks=256, block_size=8, max_num_seqs=8,
+            prefill_token_budget=256, max_queued_requests=256,
+            max_new_tokens_default=max_new)
+        app = build_llm_app(
+            ecfg, name="failover_llm", num_replicas=1,
+            autoscaling_config={
+                "min_replicas": 1, "max_replicas": 3,
+                "target_ongoing_requests": 3.0,
+                "upscale_delay_s": 0.5, "downscale_delay_s": 30.0},
+            max_ongoing_requests=48,
+            warm_prefix=shared_prefix,
+            ray_actor_options={"num_cpus": 1})
+        handle = serve.run(app)
+        rng = __import__("random").Random(0)
+
+        def prompt(i):
+            return shared_prefix + [1 + (7 * i) % 120 for _ in range(4)]
+
+        episode_deadline = time.monotonic() + 300.0
+        counters_lock = threading.Lock()
+        first_tokens = [0]
+        kill_gate = threading.Event()
+        results = []  # (cls, outcome, ttft_or_None, errtype_or_None)
+
+        def run_stream(i, cls):
+            req = {"prompt": prompt(i), "max_new_tokens": max_new,
+                   "priority": cls}
+            t0 = time.perf_counter()
+            while time.monotonic() < episode_deadline:
+                try:
+                    gen = handle.options(stream=True,
+                                         priority=cls).remote(req)
+                    toks = []
+                    for tok in gen:
+                        if not toks:
+                            ttft = time.perf_counter() - t0
+                            with counters_lock:
+                                first_tokens[0] += 1
+                                if first_tokens[0] >= 6:
+                                    kill_gate.set()
+                        toks.append(tok)
+                    if len(toks) == max_new:
+                        results.append((cls, "ok", ttft, None))
+                        return "ok"
+                except RequestSheddedError:
+                    if cls != 0:
+                        results.append((cls, "shed", None, None))
+                        return "shed"
+                    time.sleep(0.3 * (0.5 + rng.random()))
+                except (ObjectLostError, OwnerDiedError) as exc:
+                    results.append((cls, "ref_lost", None,
+                                    type(exc).__name__))
+                    return "ref_lost"
+                except Exception:  # noqa: BLE001 — blackout: retry
+                    time.sleep(0.3 * (0.5 + rng.random()))
+            results.append((cls, "timeout", None, None))
+            return "timeout"
+
+        # The fault: SIGKILL the PRIMARY HEAD once the ramp is
+        # mid-flight (6 first tokens served).
+        killer = chaos_util.NodeKiller(
+            [chaos_util.head_kill_target(primary)],
+            seed=15, interval_s=(0.01, 0.05), max_kills=1)
+
+        def arm_killer():
+            if kill_gate.wait(timeout=240):
+                killer.start()
+
+        threading.Thread(target=arm_killer, daemon=True).start()
+
+        shape = (loadgen.Ramp(0.5, 3.0, 12.0)
+                 >> loadgen.Ramp(3.0, 0.5, 10.0))
+        gen = loadgen.LoadGenerator(
+            shape, lambda i, t: run_stream(i, 0), seed=15,
+            max_concurrency=64)
+        low_threads = [
+            threading.Thread(target=run_stream, args=(10_000 + i, 3),
+                             daemon=True) for i in range(n_low)]
+        t_episode = time.perf_counter()
+        for t in low_threads:
+            t.start()
+        gen.run(timeout_s=280)
+        for t in low_threads:
+            t.join(120)
+        episode_wall = time.perf_counter() - t_episode
+        killer.stop()
+        kills = [k for k in killer.kills if "error" not in k]
+        _slo_assert("head_failover", bool(kills),
+                    "the mid-ramp HEAD kill never fired")
+        assert primary.poll() is not None, "primary survived SIGKILL?"
+
+        # Give the failover bookkeeping a beat to settle (heartbeats
+        # tick at 0.5s, and the blackout records on the first
+        # successful round trip AFTER the failover observation), then
+        # interrogate the promoted control plane.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                w.head_client.failovers < 1
+                or w.head_client.last_blackout_s is None):
+            time.sleep(0.2)
+        stats = w.head_client.head_stats()
+
+        ok_high = sorted(t for c, o, t, _ in results
+                         if c == 0 and o == "ok")
+        ok_low = sum(1 for c, o, _, _ in results
+                     if c == 3 and o == "ok")
+        shed_low = sum(1 for c, o, _, _ in results
+                       if c == 3 and o == "shed")
+        ref_lost = [e for _, o, _, e in results if o == "ref_lost"]
+        failed = sum(1 for _, o, _, _ in results
+                     if o in ("timeout", "ref_lost"))
+        total = len(results)
+        effective_denom = max(total - shed_low, 1)
+        success = (len(ok_high) + ok_low) / effective_denom
+        # SLO gates auto-capture a cluster debug bundle on failure
+        # (maybe_capture_debug — evidence dies with teardown).
+        _slo_assert("head_failover", not ref_lost,
+                    f"head failover leaked refs: typed ref-loss "
+                    f"errors surfaced: {ref_lost}")
+        _slo_assert("head_failover", success >= 0.99,
+                    f"effective success {success:.3f} < 0.99 "
+                    f"(failed={failed}, shed={shed_low})")
+        _slo_assert("head_failover",
+                    w.head_client.failovers >= 1
+                    and w.head_client.last_blackout_s is not None,
+                    f"failover never observed by the driver "
+                    f"(failovers={w.head_client.failovers})")
+        _slo_assert("head_failover",
+                    stats["epoch"] >= 2 and not stats["fenced"],
+                    f"promoted head state wrong: {stats}")
+        # One fresh end-to-end stream through the promoted plane —
+        # with its OWN retry budget: the episode deadline may be
+        # nearly (or fully) spent after a slow traffic phase, and an
+        # expired budget would read as a spurious "timeout" here.
+        episode_deadline = time.monotonic() + 120.0
+        _slo_assert("head_failover", run_stream(99_999, 0) == "ok",
+                    "post-promotion stream failed")
+
+        blackout = w.head_client.last_blackout_s
+        p99 = ok_high[min(len(ok_high) - 1, int(len(ok_high) * 0.99))]
+        p50 = ok_high[len(ok_high) // 2]
+        summary = scaler.summary()
+        result.update({
+            "traffic_shape": shape.describe(),
+            "seed": 15,
+            "scheduled_requests": len(gen.schedule),
+            "n_low_priority": n_low,
+            "max_new_tokens": max_new,
+            "episode_wall_s": episode_wall,
+            "blackout_s": blackout,
+            "blackouts_s": list(w.head_client.blackouts),
+            "failovers_observed": w.head_client.failovers,
+            "head_epoch": stats["epoch"],
+            "standby_probe_period_s": probe_s,
+            "standby_misses_to_promote": misses,
+            "p99_ttft_under_failover": p99,
+            "p50_ttft_under_failover": p50,
+            "effective_success_rate": success,
+            "completed_high": len(ok_high),
+            "completed_low": ok_low,
+            "shed_by_policy": shed_low,
+            "failed": failed,
+            "ref_lost_errors": len(ref_lost),
+            "kills": kills,
+            "nodes_launched": len(summary["launched"]),
+            "launch_attempts": summary["launch_attempts"],
+            "launch_failures": summary["launch_failures"],
+            "autoscaler_failovers": scaler.head.failovers,
+            "wire_fault_counters": chaos_util.wire_counters(),
+            "timing": ("one seeded open-loop episode, CPU backend, "
+                       "real primary+standby heads over one shared "
+                       "state log, autoscaler-launched node daemons; "
+                       "the PRIMARY HEAD SIGKILLed mid-ramp, standby "
+                       "promoted (epoch fence), wire delay/dup armed "
+                       "on the peer plane throughout; blackout_s = "
+                       "first refused head RPC -> first reply from "
+                       "the promoted head at the driver's client"),
+        })
+    finally:
+        try:
+            if scaler is not None:
+                scaler.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        chaos_util.uninstall()
+        os.environ.pop("RAY_TPU_CHAOS", None)
+        os.environ.pop("RAY_TPU_CLUSTER_TOKEN", None)
+        for p in reversed(procs):
+            p.kill()
+            p.wait(timeout=5)
+        import shutil
+
+        shutil.rmtree(state_dir, ignore_errors=True)
+    return result
+
+
 def bench_rl_rollout(repeats=6):
     """Config #5: PPO rollout collection, CartPole, 64 vectorized envs.
     Marginal-timed via fresh-process probes (honest-timing note at
@@ -2545,7 +2867,7 @@ def main():
         "chain", "fanout", "actor", "data", "rl", "model", "sharded",
         "control_plane", "workflow", "streaming", "llm_serving",
         "llm_prefix", "chaos_slo", "ownership", "elastic_slo",
-        "trace_overhead", "flight_overhead"],
+        "head_failover", "trace_overhead", "flight_overhead"],
         default=None)
     parser.add_argument("--iters", type=int, default=500)
     parser.add_argument("--probe", default=None,
@@ -2573,6 +2895,7 @@ def main():
         "chaos_slo": bench_chaos_slo,
         "ownership": bench_ownership,
         "elastic_slo": bench_elastic_slo,
+        "head_failover": bench_head_failover,
         "trace_overhead": bench_trace_overhead,
         "flight_overhead": bench_flight_overhead,
     }

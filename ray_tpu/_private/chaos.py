@@ -54,6 +54,7 @@ __all__ = [
     "ChaosController",
     "NodeKiller",
     "KillTarget",
+    "head_kill_target",
     "install",
     "install_from_env",
     "uninstall",
@@ -310,6 +311,15 @@ def popen_kill_target(name: str, proc, kind: str = "daemon",
         return {"pid": proc.pid}
 
     return KillTarget(name=name, kind=kind, kill=_kill, once=once)
+
+
+def head_kill_target(proc, name: str = "head") -> KillTarget:
+    """Target that SIGKILLs the HEAD process (the control plane itself
+    — the failover suite's fault). ``once``: a dead primary stays dead;
+    the warm standby promotes over the shared state log and clients
+    fail over by epoch, which is exactly what the matrix rows and
+    ``bench.py --suite head_failover`` assert."""
+    return popen_kill_target(name, proc, kind="head", once=True)
 
 
 def pid_kill_target(name: str, pid_fn: Callable[[], Optional[int]],

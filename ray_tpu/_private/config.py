@@ -319,3 +319,27 @@ _D("serve_wake_timeout_s", float, 30.0,
    "Scale-to-zero wake bound: a request arriving at a deployment with "
    "zero replicas queues while the controller scales it back up, and "
    "fails typed only past this many seconds.")
+_D("head_addresses", str, "",
+   "Comma-separated head addresses, primary first then standbys "
+   "(RAY_TPU_HEAD_ADDRESSES). Merged into every HeadClient's dial "
+   "list and inherited by spawned node daemons, so the whole process "
+   "tree learns the standby list and fails over without restarts "
+   "('' = only the address passed to init/--address).")
+_D("head_standby_probe_period_s", float, 1.0,
+   "Warm-standby probe period: how often the standby head probes the "
+   "primary's request channel before deciding it is dead.")
+_D("head_standby_misses_to_promote", int, 3,
+   "Consecutive failed standby probes before the standby promotes "
+   "itself over the shared state log (promotion still waits on the "
+   "log's flock fence — a stalled-but-alive primary blocks it).")
+_D("head_dial_timeout_s", float, 5.0,
+   "Per-address TCP dial bound when (re)connecting to a head: a "
+   "client failing over walks its address list paying at most this "
+   "much per unreachable standby (the heartbeat loop's re-dial budget "
+   "rides the same bound).")
+_D("head_failover_wait_s", float, 20.0,
+   "How long in-flight head RPCs retry across a head blackout: the "
+   "request coalescer replays unacked idempotent batches against "
+   "re-dials (standby promotion window) up to this bound before "
+   "failing callers; non-replayable relays fail immediately with "
+   "HeadFailedOverError.")

@@ -20,16 +20,31 @@ queue behind bulk traffic.
 
 All three channels **reconnect-and-resume**: if the head restarts (it
 persists its directories — GCS FT), the heartbeat loop re-dials until the
-head answers, requests retry once over a fresh connection, and the event
+head answers, requests retry over fresh connections, and the event
 channel re-issues its hello so relays resume. Directory entries this
 client owns survive in the head's append-log; re-registration is not
-required.
+required for a plain restart.
+
+**Failover** (replicated head): the dial list covers the standby heads
+(``address="primary,standby"`` plus ``RAY_TPU_HEAD_ADDRESSES``
+inherited at spawn). Every head advertises its **epoch** (bumped per
+incarnation over the shared state log) in hello and heartbeat replies;
+this client tracks the highest seen, refuses regressions (a fenced old
+primary on a stale-but-healthy connection), and gossips its view back
+on heartbeats so a superseded head fences itself. In-flight idempotent
+RPCs replay against the promoted head for up to
+``head_failover_wait_s`` (the blackout); non-replayable relays
+(``actor_call``/``actor_push``) surface a typed
+``HeadFailedOverError``. An observed epoch increase fires the
+``failover_callbacks`` re-registration hooks and records the measured
+blackout (``last_blackout_s`` — the gated SLO).
 """
 
 from __future__ import annotations
 
 import pickle
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -118,6 +133,17 @@ class HeadClient:
     def __init__(self, address: str, client_id: Optional[str] = None,
                  token: Optional[str] = None):
         self.addresses = parse_addresses(address)
+        # Standby list (RAY_TPU_HEAD_ADDRESSES, inherited by spawned
+        # daemons): merged behind the explicit address, so a process
+        # whose launcher only knew the primary still learns where to
+        # fail over.
+        from ray_tpu._private.config import GlobalConfig
+
+        env_addresses = GlobalConfig.head_addresses
+        if env_addresses:
+            for addr in parse_addresses(env_addresses):
+                if addr not in self.addresses:
+                    self.addresses.append(addr)
         self.address = self.addresses[0]
         self.token = None
         last: Optional[Exception] = None
@@ -145,6 +171,22 @@ class HeadClient:
         self._subs: Dict[str, list] = {}  # topic -> delivery callbacks
         self._reconnect_lock = sanitizer.tracked_lock(
             "head_client.reconnect")
+        # Failover plane: the highest head epoch this client has seen.
+        # A dial (or heartbeat) answered with a LOWER epoch is a fenced
+        # old incarnation — rejected, never trusted. An INCREASE after
+        # first contact is a failover: callbacks fire (re-registration
+        # hooks) and the blackout (first refused RPC -> first reply
+        # from the promoted head) is measured for the SLO gate.
+        self._epoch_lock = sanitizer.tracked_lock("head_client.epoch")
+        self.head_epoch = 0
+        self.failovers = 0              # observed epoch increases
+        self.last_blackout_s: Optional[float] = None
+        self.blackouts: list = []       # every measured failover blackout
+        self._down_since: Optional[float] = None
+        self._down_epoch = 0
+        # Called as cb(old_epoch, new_epoch) on a dedicated thread after
+        # a failover is observed (node re-join, named-actor reconcile).
+        self.failover_callbacks: list = []
         self._stop = threading.Event()
         self._req = self._dial("request")
         self._hb = self._dial("request")
@@ -214,20 +256,121 @@ class HeadClient:
     def _dial(self, role: str) -> FramedConnection:
         """Dial the active head; on failure try the other configured
         addresses (standby failover) — whichever answers becomes the
-        active address for subsequent dials."""
+        active address for subsequent dials. A head whose hello reply
+        advertises an epoch BELOW the highest this client has seen is a
+        fenced old incarnation: its connection is dropped and the walk
+        continues (the wire half of the split-brain fence)."""
+        from ray_tpu._private.config import GlobalConfig
+
+        dial_timeout = float(GlobalConfig.head_dial_timeout_s)
         ordered = [self.address] + [a for a in self.addresses
                                     if a != self.address]
         last: Optional[Exception] = None
         for addr in ordered:
             try:
-                conn = connect(*addr, self.token, timeout=5.0, site="head")
+                conn = connect(*addr, self.token, timeout=dial_timeout,
+                               site="head")
                 conn.send(("hello", self.client_id, role))
-                self._check(conn.recv())
+                hello = self._check(conn.recv())
+                epoch = hello.get("epoch") \
+                    if isinstance(hello, dict) else None
+                if isinstance(hello, dict) and hello.get("fenced"):
+                    conn.close()
+                    last = ConnectionError(
+                        f"head at {addr[0]}:{addr[1]} is fenced "
+                        f"(superseded incarnation), trying the next "
+                        f"address")
+                    continue
+                if epoch is not None and \
+                        not self._observe_epoch(int(epoch)):
+                    conn.close()
+                    last = ConnectionError(
+                        f"head at {addr[0]}:{addr[1]} advertises "
+                        f"epoch {epoch} < {self.head_epoch} seen — "
+                        f"fenced old incarnation, trying the next "
+                        f"address")
+                    continue
                 self.address = addr
                 return conn
             except Exception as exc:  # noqa: BLE001 — try next head
                 last = exc
         raise last if last is not None else ConnectionError("no head")
+
+    # ------------------------------------------------------- failover plane
+    def _observe_epoch(self, epoch: int) -> bool:
+        """Fold one head-advertised epoch into this client's view.
+        Returns False when ``epoch`` regressed below the highest seen
+        (caller must reject the connection); fires the failover
+        callbacks on the first observation of each INCREASE past the
+        initial attach."""
+        fire = None
+        with self._epoch_lock:
+            if epoch < self.head_epoch:
+                return False
+            if epoch > self.head_epoch:
+                old, self.head_epoch = self.head_epoch, epoch
+                if old != 0:
+                    self.failovers += 1
+                    fire = (old, epoch)
+                    # The bump itself is outage evidence: a channel may
+                    # observe the promoted head on its re-dial BEFORE
+                    # any RPC failure was noted (event-loop EOF path) —
+                    # without this, _down_epoch would equal the NEW
+                    # epoch and the blackout would never record.
+                    if self._down_since is None:
+                        self._down_since = time.monotonic()
+                        self._down_epoch = old
+                    else:
+                        self._down_epoch = min(self._down_epoch, old)
+        if fire is not None:
+            log.warning("head failover observed: epoch %d -> %d — "
+                        "re-registering with the promoted head",
+                        *fire)
+            from ray_tpu._private import flight as _flight
+
+            rec = _flight.recorder()
+            if rec is not None:
+                rec.record("head.failover", {
+                    "old_epoch": fire[0], "new_epoch": fire[1],
+                    "client": self.client_id})
+            callbacks = list(self.failover_callbacks)
+            if callbacks:
+                def _run(cbs=callbacks, args=fire):
+                    for cb in cbs:
+                        try:
+                            cb(*args)
+                        except Exception as exc:  # noqa: BLE001
+                            log.warning("failover re-registration "
+                                        "callback failed: %r", exc)
+
+                threading.Thread(target=_run, daemon=True,
+                                 name="ray_tpu_head_failover").start()
+        return True
+
+    def _note_head_down(self) -> None:
+        """First refused head RPC of an outage: blackout clock starts."""
+        with self._epoch_lock:
+            if self._down_since is None:
+                self._down_since = time.monotonic()
+                self._down_epoch = self.head_epoch
+
+    def _note_head_up(self) -> None:
+        """A head RPC round trip completed: if the outage (first
+        refused RPC, or the failover observation itself when no RPC
+        failed first) spanned an epoch bump, the gap was a FAILOVER
+        blackout — record it."""
+        with self._epoch_lock:
+            if self._down_since is None:
+                return
+            down_since, self._down_since = self._down_since, None
+            if self.head_epoch <= self._down_epoch:
+                return  # same incarnation hiccup, not a failover
+            blackout = time.monotonic() - down_since
+            self.last_blackout_s = blackout
+            self.blackouts.append(blackout)
+        log.warning("head failover blackout: %.3fs from first refused "
+                    "RPC to first reply from the promoted head",
+                    blackout)
 
     @staticmethod
     def _check(reply):
@@ -347,41 +490,132 @@ class HeadClient:
             # Bytes may be on the wire and the reply stream is suspect:
             # the ONLY safe recovery is a fresh connection, and only for
             # idempotent members. Retried ops are put-style (last-write-
-            # wins); actor_call/actor_push relays may have EXECUTED
-            # before the reply was lost, so resending would double a
-            # remote side effect — their callers get the error instead.
+            # wins) and REPLAY across a head failover: the re-dial walks
+            # the standby list for up to head_failover_wait_s, so a
+            # SIGKILLed head mid-batch costs its callers the blackout,
+            # not an error. actor_call/actor_push relays may have
+            # EXECUTED before the reply was lost, so resending would
+            # double a remote side effect — their callers get a typed
+            # HeadFailedOverError instead.
             if self._stop.is_set():
                 self._fail_batch(batch, exc)
                 return
+            self._note_head_down()
             unsafe = [it for it in batch
                       if it[0] and it[0][0] in _NON_IDEMPOTENT_KINDS]
             if unsafe:
-                self._fail_batch(unsafe, ConnectionError(
-                    f"connection died mid-call; the relay may or may not "
-                    f"have executed ({exc})"))
+                from ray_tpu.exceptions import HeadFailedOverError
+
+                self._fail_batch(unsafe, HeadFailedOverError(
+                    f"head connection died mid-call; the relay may or "
+                    f"may not have executed ({exc})"))
                 batch = [it for it in batch
                          if not (it[0] and it[0][0]
                                  in _NON_IDEMPOTENT_KINDS)]
                 if not batch:
                     return
-                msgs = [m for m, _ in batch]
+            res = self._replay_batch(batch, exc)
+            if res is None:
+                return  # _replay_batch failed every caller already
+            batch, replies = res
+        # A FENCED head refuses requests without executing them (typed
+        # HeadFailedOverError replies): replaying those members against
+        # the promoted head is safe for every kind, relays included —
+        # the refusal is proof nothing ran. (_replay_batch itself
+        # re-applies the non-idempotent rule if its OWN resend dies
+        # post-write, so a relay can still never execute twice.)
+        fenced_idx = [i for i, rep in enumerate(replies)
+                      if self._is_fenced_reply(rep)]
+        if fenced_idx and not self._stop.is_set():
+            self._note_head_down()
+            sub = [batch[i] for i in fenced_idx]
+            res = self._replay_batch(sub, ConnectionError(
+                "head refused the batch as fenced"))
+            replayed = {}
+            if res is not None:
+                sub2, sub_replies = res
+                replayed = {id(slot): rep
+                            for (_, slot), rep in zip(sub2, sub_replies)}
+            for (_, slot), rep in zip(batch, replies):
+                if self._is_fenced_reply(rep):
+                    rep = replayed.get(id(slot))
+                    if rep is None:
+                        continue  # failed (slot already answered)
+                slot.reply = rep
+                slot.event.set()
+            self._note_head_up()
+            return
+        self._note_head_up()
+        for (_, slot), rep in zip(batch, replies):
+            slot.reply = rep
+            slot.event.set()
+
+    @staticmethod
+    def _is_fenced_reply(rep) -> bool:
+        return isinstance(rep, (tuple, list)) and len(rep) == 2 \
+            and rep[0] == "err" and isinstance(rep[1], dict) \
+            and rep[1].get("type") == "HeadFailedOverError"
+
+    def _replay_batch(self, batch: list, first_exc: BaseException):
+        """Replay one batch of idempotent-or-refused requests over
+        fresh dials until a head answers or the failover window
+        closes. Returns ``(batch, replies)`` — the surviving subset
+        and its aligned replies — or None after failing every caller
+        (bounded: a cluster with no surviving head must not park
+        callers forever). Non-idempotent relays are only ever SENT
+        once here: if a resend dies post-write (the reply-lost
+        ambiguity), they fail typed and are dropped from further
+        retries, so a relayed side effect can never double."""
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu._private.transport import pack
+
+        deadline = time.monotonic() + float(
+            GlobalConfig.head_failover_wait_s)
+        last: BaseException = first_exc
+        while True:
+            if self._stop.is_set() or time.monotonic() >= deadline \
+                    or not batch:
+                self._fail_batch(batch, last)
+                return None
+            msgs = [m for m, _ in batch]
             try:
                 try:
                     self._req.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # noqa: BLE001 — already dead
+                    log.debug("closing dead request conn: %r", exc)
                 self._req = self._dial("request")
                 if len(msgs) == 1:
                     payload = pack(msgs[0])
                 else:
                     payload = pack(("batch", tuple(msgs)))
-                replies = self._roundtrip_batch(payload, len(msgs))
-            except Exception as exc2:  # noqa: BLE001 — still down
-                self._fail_batch(batch, exc2)
-                return
-        for (_, slot), rep in zip(batch, replies):
-            slot.reply = rep
-            slot.event.set()
+            except Exception as exc:  # noqa: BLE001 — nothing written:
+                last = exc            # retrying everything stays safe
+                log.debug("head re-dial failed; retrying until the "
+                          "failover window closes: %r", exc)
+                # Promotion takes probes x period + log replay: pace
+                # the walk instead of hammering refused connections.
+                self._stop.wait(0.25)
+                continue
+            try:
+                return batch, self._roundtrip_batch(payload, len(msgs))
+            except Exception as exc:  # noqa: BLE001 — post-WRITE death:
+                last = exc
+                # the reply is lost and relays may have executed — the
+                # same ambiguity rule as the first failure applies.
+                unsafe = [it for it in batch
+                          if it[0] and it[0][0] in _NON_IDEMPOTENT_KINDS]
+                if unsafe:
+                    from ray_tpu.exceptions import HeadFailedOverError
+
+                    self._fail_batch(unsafe, HeadFailedOverError(
+                        f"head connection died mid-replay; the relay "
+                        f"may or may not have executed ({exc})"))
+                    batch = [it for it in batch
+                             if not (it[0] and it[0][0]
+                                     in _NON_IDEMPOTENT_KINDS)]
+                log.debug("head batch replay failed; retrying until "
+                          "the failover window closes: %r", exc)
+                self._stop.wait(0.25)
 
     @staticmethod
     def _fail_batch(batch: list, exc: BaseException):
@@ -905,12 +1139,28 @@ class HeadClient:
             if topics:
                 status["_subs"] = topics
             status["_peer_addr"] = list(self._object_server.address)
+            # Epoch gossip: the head compares this against its own —
+            # a fenced old primary learns it was superseded from the
+            # first surviving client that heartbeats it.
+            status["_epoch"] = self.head_epoch
             msg = ("heartbeat", status)
             with self._hb_lock:
                 hb = self._hb
             try:
                 hb.send(msg)
-                self._check(hb.recv())
+                val = self._check(hb.recv())
+                # Failover blind-spot fix: the reply carries the serving
+                # head's epoch. A REGRESSION means this stale connection
+                # reaches a fenced old incarnation that merely looks
+                # healthy — treat it as a failed heartbeat and re-dial
+                # (the dial walk rejects the fenced head by epoch too).
+                if isinstance(val, dict) and "epoch" in val:
+                    if not self._observe_epoch(int(val["epoch"])):
+                        raise ConnectionError(
+                            f"heartbeat answered by a fenced head "
+                            f"(epoch {val['epoch']} < "
+                            f"{self.head_epoch} seen)")
+                self._note_head_up()
                 # Feed the flight recorder's heartbeat-gap watchdog: a
                 # wedged daemon stops completing round trips, and the
                 # watchdog auto-dumps what every thread was doing.
@@ -919,6 +1169,8 @@ class HeadClient:
                 if _flight._FLIGHT is not None:
                     _flight.beat("head_link")
             except Exception as exc:  # re-dial until the head returns
+                if not self._stop.is_set():
+                    self._note_head_down()
                 log.debug("heartbeat failed; re-dialing head: %r", exc)
                 try:
                     hb.close()

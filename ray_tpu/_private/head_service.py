@@ -338,12 +338,29 @@ class HeadService:
             GlobalConfig.head_log_compact_records)
         self._compact_pending = False
         self._log: Optional[_StateLog] = None
+        # Head epoch (wire fence, the flock's twin): every boot over a
+        # state log is a new incarnation — replay the highest epoch
+        # seen, serve as epoch+1, and persist it. Promotion IS a boot
+        # over the shared log, so the promoted standby's epoch strictly
+        # exceeds the dead primary's; clients reject regressions, and a
+        # fenced incarnation refuses every request (see _dispatch).
+        self._replayed_epoch = 0
+        self._fenced = False
+        self.fenced_refusals = 0
         if state_path:
             # Fence FIRST (blocks until any prior writer is truly
             # dead), then replay: the log cannot grow a tail under us
             # between replay and serving.
             self._log = _StateLog(state_path)
             self._restore(state_path)
+        self.epoch = self._replayed_epoch + 1
+        if self._log is not None:
+            self._persist("epoch", self.epoch)
+        # Promotion/restart over existing state is an incident-worthy
+        # lifecycle event: it lands in the flight ring when armed.
+        if self._replayed_epoch > 0:
+            log.warning("head serving epoch %d over replayed state "
+                        "(promotion or restart)", self.epoch)
         # Batched control RPCs: a client's coalescer ships N requests in
         # one frame; sub-requests dispatch CONCURRENTLY here so a batch
         # of relays (task_push / task_done / chunk reads) overlaps their
@@ -374,12 +391,23 @@ class HeadService:
         rec = _flight.install_from_env(component="head")
         if rec is not None:
             rec.add_section("head", self._flight_head_section)
+            if self._replayed_epoch > 0:
+                # Failover/restart incident marker: the promoted head's
+                # first bundle shows WHEN it took over and from which
+                # incarnation.
+                rec.record("head.promoted", {
+                    "epoch": self.epoch,
+                    "replayed_epoch": self._replayed_epoch})
         # Cluster metrics scrape plane: a PeerPool for pulling each
         # node's /metrics registry over its direct object server
         # (lazily used by serve_cluster_metrics / the metrics_scrape
         # RPC; costs nothing while nobody scrapes).
         self._metrics_peers = None
         self._metrics_server = None
+        # Live request connections (shutdown closes them: a stopped
+        # head must drop its clients so they fail over — an in-process
+        # test promotion behaves like the SIGKILL it stands in for).
+        self._conns: set = set()
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
@@ -390,6 +418,8 @@ class HeadService:
         per-kind RPC profile (the O(membership) flatness observable)."""
         with self._lock:
             return {
+                "epoch": self.epoch,
+                "fenced": self._fenced,
                 "rpc_counts": dict(self.rpc_counts),
                 "batches_received": self.batches_received,
                 "num_objects": len(self._objects),
@@ -408,10 +438,18 @@ class HeadService:
         through the normal monitor path and their entries GC."""
         for rec in _StateLog.replay(state_path):
             op = rec[0]
+            if op == "epoch":
+                self._replayed_epoch = max(self._replayed_epoch,
+                                           int(rec[1]))
+                continue
             if op == "snapshot":
                 # Full-state record from compaction: replaces everything
                 # replayed so far (it IS the log's prefix after rewrite).
-                _, kv, actors, objects, nodes, places = rec
+                # Arity-tolerant: pre-epoch snapshots carry 5 sections.
+                kv, actors, objects, nodes, places = rec[1:6]
+                if len(rec) > 6:
+                    self._replayed_epoch = max(self._replayed_epoch,
+                                               int(rec[6]))
                 self._kv = {bytes(k): bytes(v) for k, v in kv}
                 self._actors = {
                     (ns, name): (cid, bytes(abin), cls)
@@ -487,6 +525,7 @@ class HeadService:
                 [(c.client_id, c.node_id, c.resources)
                  for c in self._clients.values() if c.is_node],
                 [(a, r) for a, r in self._places.items()],
+                self.epoch,
             )
             self._log.rewrite(snapshot)
 
@@ -509,6 +548,7 @@ class HeadService:
         except Exception:  # noqa: BLE001 — unauthenticated peer
             conn.close()
             return
+        handed_off = False  # event channels belong to their reader
         try:
             hello = conn.recv()  # ("hello", client_id, role)
             _, client_id, role = hello
@@ -523,9 +563,18 @@ class HeadService:
                 c.events = _EventChannel(conn)
                 if old is not None:
                     old.fail_all("event channel replaced by reconnect")
-                conn.send(("ok", None))
+                conn.send(("ok", {"epoch": self.epoch,
+                                  "fenced": self._fenced}))
+                handed_off = True
                 return  # reader thread owns the connection now
-            conn.send(("ok", None))
+            # Hello reply advertises this incarnation's epoch (and
+            # whether it is already fenced): a client that saw a NEWER
+            # head — or any client offered a fenced one — refuses the
+            # connection (the wire half of the split-brain fence).
+            conn.send(("ok", {"epoch": self.epoch,
+                              "fenced": self._fenced}))
+            with self._lock:
+                self._conns.add(conn)
             while not self._stop.is_set():
                 msg = conn.recv()
                 if msg and msg[0] == "batch":
@@ -544,6 +593,11 @@ class HeadService:
             pass
         except Exception:  # noqa: BLE001 — connection error boundary
             pass
+        finally:
+            if not handed_off:
+                with self._lock:
+                    self._conns.discard(conn)
+                conn.close()
 
     # ------------------------------------------------------------ dispatch
     def _dispatch_batch(self, client_id: str, msgs) -> list:
@@ -597,6 +651,18 @@ class HeadService:
                 c.last_seen = time.monotonic()
                 c.alive = True
                 self.rpc_counts[kind] = self.rpc_counts.get(kind, 0) + 1
+            if self._fenced and kind != "heartbeat":
+                # Fenced incarnation: a newer head is serving. Refuse
+                # EVERYTHING (reads too — our directories are stale) so
+                # clients fail over; heartbeats still answer, carrying
+                # the regressed epoch that triggers their re-dial.
+                from ray_tpu.exceptions import HeadFailedOverError
+
+                self.fenced_refusals += 1
+                return ("err", exc_to_wire(HeadFailedOverError(
+                    f"head epoch {self.epoch} is fenced (a promoted "
+                    f"head superseded it) — re-dial the address list",
+                    epoch=self.epoch)))
             if kind == "heartbeat":
                 if len(msg) > 1 and isinstance(msg[1], dict):
                     with self._lock:
@@ -614,7 +680,33 @@ class HeadService:
                         addr = msg[1].get("_peer_addr")
                         if addr is not None:
                             c.peer_addr = (str(addr[0]), int(addr[1]))
-                return ("ok", None)
+                    # Epoch gossip: a client reporting a NEWER head has
+                    # seen our successor — we lost a promotion race (or
+                    # un-wedged after one). Fence this incarnation: all
+                    # further requests refuse typed so stale
+                    # connections fail over instead of writing here.
+                    seen = msg[1].get("_epoch")
+                    if isinstance(seen, int) and seen > self.epoch \
+                            and not self._fenced:
+                        self._fenced = True
+                        log.warning(
+                            "head epoch %d fenced: client %s reports a "
+                            "promoted head at epoch %d — refusing all "
+                            "further requests", self.epoch, client_id,
+                            seen)
+                        from ray_tpu._private import flight as _flight
+
+                        rec2 = _flight.recorder()
+                        if rec2 is not None:
+                            rec2.record("head.fenced", {
+                                "epoch": self.epoch,
+                                "superseded_by": seen})
+                # The heartbeat reply carries the serving epoch even
+                # when fenced: the client sees the regression and
+                # re-dials instead of trusting a healthy-looking
+                # connection to a dead incarnation.
+                return ("ok", {"epoch": self.epoch,
+                               "fenced": self._fenced})
             if kind == "subscribe":
                 with self._lock:
                     if msg[1] not in c.subs:
@@ -657,7 +749,12 @@ class HeadService:
                 _, namespace, name, actor_bin, class_name = msg
                 with self._lock:
                     existing = self._actors.get((namespace, name))
-                    if existing is not None and self._is_alive(existing[0]):
+                    # Re-registration by the SAME owner is a reconcile
+                    # (failover re-join, not a name conflict): the
+                    # owner's live truth overwrites the replayed entry.
+                    if existing is not None \
+                            and existing[0] != client_id \
+                            and self._is_alive(existing[0]):
                         return ("err", exc_to_wire(ValueError(
                             f"actor name {name!r} already taken in "
                             f"namespace {namespace!r}")))
@@ -763,6 +860,9 @@ class HeadService:
                         if cl.is_node and cl.alive)
                 state_log = self._log
                 return ("ok", {
+                    "epoch": self.epoch,
+                    "fenced": self._fenced,
+                    "fenced_refusals": self.fenced_refusals,
                     "rpc_counts": counts,
                     "rpc_total": sum(counts.values()),
                     "object_plane_rpcs": sum(
@@ -1201,6 +1301,25 @@ class HeadService:
     def shutdown(self):
         self._stop.set()
         self._listener.close()
+        # Drop every live client connection (request AND event planes):
+        # surviving clients must observe the death and fail over, not
+        # keep talking to a stopped head's lingering sockets.
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+            events = [c.events for c in self._clients.values()
+                      if c.events is not None]
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for ev in events:
+            ev.fail_all("head shut down")
+            try:
+                ev.conn.close()
+            except OSError:
+                pass
         self._rpc_pool.shutdown(wait=False, cancel_futures=True)
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
@@ -1211,17 +1330,28 @@ class HeadService:
             self._log.close()
 
 
-def run_standby(primary: str, token: str, probe_period_s: float = 1.0,
-                misses_to_promote: int = 3) -> None:
+def run_standby(primary: str, token: str,
+                probe_period_s: Optional[float] = None,
+                misses_to_promote: Optional[int] = None) -> None:
     """Warm-standby loop (GCS-FT replicated-head role): probe the
     primary's request channel; after `misses_to_promote` consecutive
     failures, return so the caller promotes this process to a serving
     head over the SHARED state log. Clients configured with
-    ``address="primary,standby"`` fail over on their next dial."""
+    ``address="primary,standby"`` fail over on their next dial. The
+    probe cadence defaults from RAY_TPU_HEAD_STANDBY_PROBE_PERIOD_S /
+    RAY_TPU_HEAD_STANDBY_MISSES_TO_PROMOTE — the blackout bound is
+    roughly probes x period + promotion replay, so tests and latency-
+    sensitive deployments tighten both."""
     import uuid
 
+    from ray_tpu._private.config import GlobalConfig
     from ray_tpu._private.transport import connect as _connect
 
+    if probe_period_s is None:
+        probe_period_s = float(GlobalConfig.head_standby_probe_period_s)
+    if misses_to_promote is None:
+        misses_to_promote = int(
+            GlobalConfig.head_standby_misses_to_promote)
     host, _, port = primary.rpartition(":")
     misses = 0
     probe_id = f"standby-{uuid.uuid4().hex[:8]}"

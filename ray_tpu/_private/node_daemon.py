@@ -196,6 +196,13 @@ class NodeDaemon:
         self.head.node_register(
             self.worker.node_id.hex(), self.worker.resource_pool.total,
             trace=self._join_trace)
+        # Head failover: when the client observes a promoted head
+        # (epoch bump), re-join — the promoted head replayed membership
+        # from the shared log, but a register lost in the dead
+        # primary's torn tail (or a log-less head) reconciles here, and
+        # the re-join refreshes peer_addr/status ahead of the next
+        # heartbeat.
+        self.head.failover_callbacks.append(self._on_head_failover)
         if self._init_span is not None:
             tracing.finish(self._init_span)
             self._init_span = None
@@ -310,6 +317,20 @@ class NodeDaemon:
         # cluster tasks with ZERO new steady-state head RPCs.
         self._events_cursor = 0
         self.events_shipped = 0
+
+    def _on_head_failover(self, old_epoch: int, new_epoch: int):
+        """Re-join announcement for the promoted head (reconciles the
+        replayed membership — idempotent on the head side)."""
+        try:
+            self.head.node_register(self.worker.node_id.hex(),
+                                    self.worker.resource_pool.total)
+            log.warning("re-registered with promoted head (epoch %d -> "
+                        "%d)", old_epoch, new_epoch)
+        except Exception as exc:  # noqa: BLE001 — next failover retries;
+            # the log-replayed membership entry still covers us.
+            log.warning("node re-register after head failover failed "
+                        "(log-replayed membership still covers this "
+                        "node): %r", exc)
 
     def _note_owner(self, addr: tuple, driver_id):
         """Remember the last driver this node reported to (set from
@@ -1056,9 +1077,22 @@ class NodeDaemon:
         Returns the drain report; the reaper terminates the process
         only after this reply, so a drained reap can never strand a
         borrowed ref. Bounded by the caller-supplied timeout — a
-        wedged drain degrades to crash semantics (lineage replay)."""
+        wedged drain degrades to crash semantics (lineage replay).
+
+        Exactly-once under racing reapers: the FIRST drain claims the
+        node (cordon); a concurrent second pass observes the cordon
+        and returns immediately with ``already_draining`` set and
+        current counters — it must neither re-run the offload (double
+        ``object_offload`` would double-count lease transfers) nor be
+        treated by its caller as a completed drain it owns."""
         timeout_s = float(msg[1]) if len(msg) > 1 else 15.0
-        self._draining = True
+        with self._seen_lock:
+            if self._draining:
+                return {"transferred": self.drain_transferred,
+                        "untransferred": self.drain_untransferred,
+                        "refused": self.drain_refusals,
+                        "already_draining": True}
+            self._draining = True
         deadline = time.monotonic() + max(timeout_s, 0.1)
         # 1. In-flight work finishes: queued + running tasks, then the
         # reporter queue flushes (a completed task whose report never
@@ -1115,7 +1149,8 @@ class NodeDaemon:
                           "resolution still covers these): %r", exc)
         return {"transferred": self.drain_transferred,
                 "untransferred": self.drain_untransferred,
-                "refused": self.drain_refusals}
+                "refused": self.drain_refusals,
+                "already_draining": False}
 
     # -------------------------------------------------------------- lifecycle
     def run_forever(self):

@@ -28,10 +28,13 @@ from ray_tpu._private.ids import (
 )
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.scheduler import LocalScheduler, ResourcePool, TaskSpec
+from ray_tpu._private.log import get_logger
 from ray_tpu._private.serialization import SerializationContext
 from ray_tpu._private.task_events import TaskEventBuffer
 from ray_tpu._private import tracing
 from ray_tpu.exceptions import RayTaskError, RayTpuError
+
+log = get_logger(__name__)
 
 class _TaskContext:
     """Per-execution task context. Backed by contextvars rather than
@@ -559,6 +562,56 @@ class Worker:
         self.placement_groups: Dict[Any, Any] = {}
         self._kv: Dict[bytes, bytes] = {}  # internal KV (GCS-KV parity)
         self._kv_lock = threading.Lock()
+        if self.head_client is not None:
+            # Head failover re-registration hook: when the client
+            # observes a promoted head, this driver reconciles the
+            # replayed directories with its live truth (named actors
+            # it owns, cluster-actor placements it made).
+            self.head_client.failover_callbacks.append(
+                self._on_head_failover)
+
+    def _on_head_failover(self, old_epoch: int, new_epoch: int) -> None:
+        """Re-join announcements for a promoted head: re-register this
+        driver's live named actors and re-place its live cluster
+        actors. The promoted head replayed the shared log, so most
+        entries already exist — re-registration by the same owner
+        reconciles (overwrites) rather than conflicts, and entries
+        lost in the dead primary's torn log tail reappear here."""
+        hc = self.head_client
+        if hc is None or not self.is_alive:
+            return
+        for (ns, name), handle in list(self.named_actors.items()):
+            runtime = getattr(handle, "_runtime", None)
+            if runtime is None or getattr(runtime, "dead", False):
+                continue
+            try:
+                hc.actor_register(
+                    ns, name, runtime.actor_id.binary(),
+                    getattr(runtime, "class_name", "") or "")
+            except Exception as exc:  # noqa: BLE001 — replayed entry
+                log.warning("named-actor re-register of %r after "
+                               "head failover failed (the replayed "
+                               "directory entry still serves): %r",
+                               name, exc)
+        from ray_tpu._private.remote_actor import RemoteActorRuntime
+
+        for runtime in list(self.actors.values()):
+            if not isinstance(runtime, RemoteActorRuntime) \
+                    or runtime.dead or runtime.borrower:
+                continue
+            try:
+                hc.actor_place(runtime.actor_id.binary(), {
+                    "node": runtime.node_client,
+                    "driver": hc.client_id,
+                    "cls": runtime._cls_bytes,
+                    "class_name": runtime.class_name,
+                    "detached":
+                        runtime.opts.get("lifetime") == "detached",
+                })
+            except Exception as exc:  # noqa: BLE001 — same fallback
+                log.warning("cluster-actor re-place after head "
+                               "failover failed (replayed placement "
+                               "still serves): %r", exc)
 
     def _flight_section(self) -> dict:
         """Runtime depths for this process's flight bundle: the

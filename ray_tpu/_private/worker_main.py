@@ -686,6 +686,37 @@ def _cached_runtime_env(env_fields):
     return renv
 
 
+def _install_pdeathsig() -> None:
+    """Orphan fence (Linux): a pooled worker must never outlive the
+    process that owns its shm store — a SIGKILLed hosting daemon
+    (chaos node kills, reaped nodes, the head-failover episode's
+    teardown) would otherwise leave workers spinning against dead
+    channels forever, observed as CPU-burning orphans. The kernel
+    delivers SIGKILL on parent death (PR_SET_PDEATHSIG), installed by
+    the child itself so the spawn path needs no fork-unsafe
+    preexec_fn. The parent-died-before-prctl race is closed by
+    comparing getppid() against the SPAWNER's pid handed down in
+    RAY_TPU_PARENT_PID — never against init's pid 1, which is the
+    legitimate parent when the hosting daemon runs as a container's
+    PID 1. Silently a no-op off Linux."""
+    if not sys.platform.startswith("linux"):
+        return
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, int(_signal.SIGKILL), 0, 0, 0)
+        spawner = os.environ.get("RAY_TPU_PARENT_PID")
+        if spawner and os.getppid() != int(spawner):
+            # Reparented before prctl landed: the spawner is already
+            # gone and the death signal will never fire — exit now.
+            os.kill(os.getpid(), _signal.SIGKILL)
+    except Exception:  # noqa: BLE001 — fence is best-effort hardening
+        pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--store", required=True)
@@ -697,6 +728,7 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--max-msg", type=int, default=4 << 20)
     args = ap.parse_args(argv)
+    _install_pdeathsig()
     # Tracing arms from the inherited environment; worker processes have
     # no dialable trace_dump server, so finished spans SPILL to the
     # hosting runtime's RAY_TPU_TRACE_DIR (merged by its trace_dump).

@@ -133,6 +133,11 @@ class WorkerProcess:
         # dies with "unknown backend".
         full_env.pop("PALLAS_AXON_POOL_IPS", None)
         full_env["JAX_PLATFORMS"] = "cpu"
+        # Orphan-fence handshake: the worker compares getppid() against
+        # THIS pid after installing PR_SET_PDEATHSIG (worker_main) —
+        # proven reparenting, not the ppid==1 heuristic that would
+        # false-positive when this process is a container's PID 1.
+        full_env["RAY_TPU_PARENT_PID"] = str(os.getpid())
         extra_path = [p for p in sys.path if p]
         prev = full_env.get("PYTHONPATH", "")
         full_env["PYTHONPATH"] = os.pathsep.join(

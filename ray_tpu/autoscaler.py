@@ -319,6 +319,17 @@ class LocalSubprocessProvider(NodeProvider):
         if self.worker_mode:
             cmd += ["--worker-mode", self.worker_mode]
         env = dict(self.env if self.env is not None else os.environ)
+        # Standby list inheritance: a daemon launched mid-failover (or
+        # alive across one) must know every head it may need to dial —
+        # the provider's own address list (which may already be
+        # "primary,standby") plus any configured RAY_TPU_HEAD_ADDRESSES
+        # ride into the spawned process's environment.
+        from ray_tpu._private.config import GlobalConfig
+
+        standby_list = GlobalConfig.head_addresses or (
+            self.address if "," in self.address else "")
+        if standby_list:
+            env["RAY_TPU_HEAD_ADDRESSES"] = standby_list
         from ray_tpu._private import tracing
 
         ctx = tracing.current_context()
@@ -551,7 +562,7 @@ class ClusterAutoscaler:
         if len(self.scale_events) > 256:
             del self.scale_events[:len(self.scale_events) - 256]
 
-    def _terminate(self, m: _Managed, drain: bool = False):
+    def _terminate(self, m: _Managed, drain: bool = False) -> bool:
         """Reap one managed node. With ``drain=True`` (the idle-reap
         path) the node is first asked to DRAIN: it cordons itself
         (refuse-and-reroute for racing pushes), finishes in-flight
@@ -559,7 +570,17 @@ class ClusterAutoscaler:
         owners (``object_offload``) + re-points head fallback entries
         (``object_transfer``) — so reaping can never strand a borrowed
         ref. A drain that fails (node wedged/gone) falls through to a
-        plain terminate: crash semantics (lineage) still cover it."""
+        plain terminate: crash semantics (lineage) still cover it.
+
+        Claim-first: the node leaves ``_managed`` BEFORE any drain
+        work, so two racing reap passes over the same node resolve to
+        exactly one drain + one terminate — the loser returns False
+        and must not double-count (the node side is idempotent too:
+        its second drain answers ``already_draining``)."""
+        with self._lock:
+            if m not in self._managed:
+                return False  # a concurrent pass already claimed it
+            self._managed.remove(m)
         if drain and m.client_id:
             from ray_tpu._private.config import GlobalConfig
 
@@ -580,9 +601,8 @@ class ClusterAutoscaler:
         except Exception:  # noqa: BLE001 — already gone
             pass
         with self._lock:
-            if m in self._managed:
-                self._managed.remove(m)
             self.terminated.append(m.type_name)
+        return True
 
     # --------------------------------------------------------------- demand
     def _observe(self):
@@ -728,8 +748,8 @@ class ClusterAutoscaler:
                 continue
             t = self.node_types[m.type_name]
             if counts.get(m.type_name, 0) > t.min_workers:
-                self._terminate(m, drain=True)
-                counts[m.type_name] = counts.get(m.type_name, 0) - 1
+                if self._terminate(m, drain=True):
+                    counts[m.type_name] = counts.get(m.type_name, 0) - 1
 
     def summary(self) -> Dict[str, Any]:
         """Operational counters for ``util.state.autoscaler_summary``:

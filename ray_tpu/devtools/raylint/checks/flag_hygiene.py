@@ -41,6 +41,7 @@ BOOTSTRAP_ENV_FLAGS: Set[str] = {
     "RAY_TPU_PLATFORM",          # device-plane selection before jax init
     "RAY_TPU_NUM_PROCESSES",     # multi-process identity, set by launcher
     "RAY_TPU_PROCESS_ID",        # multi-process identity, set by launcher
+    "RAY_TPU_PARENT_PID",        # spawner pid for the worker orphan fence
     "RAY_TPU_SESSION_LOG_DIR",   # injected per spawned worker/daemon
     "RAY_TPU_SANITIZE",          # sanitizer arming — must work standalone
     "RAY_TPU_SANITIZE_MODE",     # sanitizer raise-vs-warn

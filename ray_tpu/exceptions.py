@@ -191,6 +191,26 @@ class NodeLaunchFailedError(RayTpuError):
                        f"{attempts} attempt(s)")
 
 
+class HeadFailedOverError(RayTpuError, ConnectionError):
+    """The head failed over (or fenced itself after losing a
+    promotion race) while this call was in flight. Surfaced only for
+    genuinely non-replayable calls: idempotent head RPCs are replayed
+    against the promoted head transparently, but a relayed side effect
+    (actor_call/actor_push) whose reply was lost may or may not have
+    executed — the caller must decide whether to retry. Also the typed
+    refusal a FENCED old primary answers every post-promotion request
+    with (its epoch regressed below the cluster's), so a client on a
+    stale connection fails over instead of writing into a dead
+    incarnation. Subclasses ConnectionError so pre-existing
+    reconnect-on-ConnectionError paths keep working."""
+
+    def __init__(self, message: str = "", epoch: int = 0):
+        self.epoch = epoch
+        super().__init__(
+            message or "the head failed over while this call was in "
+                       "flight; the call may or may not have executed")
+
+
 class NodeDrainingError(RayTpuError):
     """A task push landed on a node already chosen for reap: the node
     refused it (drain-before-reap cordon) instead of accepting work it

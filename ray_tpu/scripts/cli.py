@@ -98,11 +98,27 @@ def cmd_start(args):
     if args.head:
         import os
 
-        from ray_tpu._private.head_service import HeadService
+        from ray_tpu._private.head_service import HeadService, run_standby
         from ray_tpu._private.transport import token_dir
 
         state = args.state or os.path.join(
             token_dir(), f"head_state_{args.port}.log")
+        if args.standby_of:
+            token = os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+            if not token or not args.state:
+                # --state must be EXPLICIT here: the per-port default
+                # would give the standby its own (empty) log, so
+                # promotion would serve empty state at a non-superseding
+                # epoch — silent data loss, not failover.
+                raise SystemExit(
+                    "--standby-of needs an explicit --state (the SAME "
+                    "log file the primary serves) and the cluster "
+                    "token in RAY_TPU_CLUSTER_TOKEN")
+            print(f"ray_tpu head standing by for {args.standby_of}",
+                  flush=True)
+            run_standby(args.standby_of, token)
+            print("ray_tpu standby promoting: primary unreachable",
+                  flush=True)
         svc = HeadService(args.host, args.port, state_path=state)
         print(f"ray_tpu head listening on {svc.host}:{svc.port} "
               f"(token file {svc.token_file})", flush=True)
@@ -243,6 +259,10 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=6380)
     p.add_argument("--state", default=None,
                    help="head FT append-log path (--head only)")
+    p.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                   help="run --head as a warm standby: serve only "
+                        "after this primary (sharing --state and the "
+                        "cluster token) stops answering")
     p.add_argument("--address", default=None, help="join head as a node")
     p.add_argument("--num-cpus", type=int, default=2)
     p.add_argument("--resources", default="{}")

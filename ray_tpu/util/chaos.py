@@ -32,6 +32,7 @@ from ray_tpu._private.chaos import (  # noqa: F401
     NodeKiller,
     active,
     current,
+    head_kill_target,
     install,
     install_from_env,
     pid_kill_target,
@@ -50,6 +51,7 @@ __all__ = [
     "NodeKiller",
     "active",
     "current",
+    "head_kill_target",
     "install",
     "install_from_env",
     "pid_kill_target",

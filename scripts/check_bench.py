@@ -91,7 +91,8 @@ DEFAULT_REQUIRED = ("cluster_fanout_1k.tasks_per_sec,"
                     "llm_prefix.cached_tokens_per_sec,"
                     "chaos_slo.p99_ttft_under_kill,"
                     "ownership.head_rpcs_per_1k_objects,"
-                    "elastic_slo.p99_ttft_under_scale")
+                    "elastic_slo.p99_ttft_under_scale,"
+                    "head_failover.blackout_s")
 
 # Flatness metrics (ownership directory): ABSOLUTE gate, not relative —
 # the head's marginal steady-state cost per 1k objects must stay ~0

@@ -978,6 +978,280 @@ def test_matrix_wire_delay_x_scale_up_cold_start_bounded(tmp_path):
         head.wait(timeout=5)
 
 
+# --------------------------------------------------------------------------
+# Head-kill rows (PR 15): the control plane ITSELF is the victim — a warm
+# standby promotes over the shared state log, clients fail over by epoch,
+# and the workload keeps its SLO (head death is a non-event).
+# --------------------------------------------------------------------------
+def _spawn_head_pair(tmp_path):
+    """(primary_proc, standby_proc, address_list_str, env) — a primary
+    + warm standby over one shared state log, promotion knobs tightened
+    so the blackout stays test-sized."""
+    import socket
+    import subprocess
+    import sys
+
+    token = "feedface%08x" % (os.getpid() & 0xFFFFFFFF)
+    env = _spawn_env({
+        "RAY_TPU_CLUSTER_TOKEN": token,
+        "RAY_TPU_HEAD_STANDBY_PROBE_PERIOD_S": "0.2",
+        "RAY_TPU_HEAD_STANDBY_MISSES_TO_PROMOTE": "2",
+    })
+    os.environ["RAY_TPU_CLUSTER_TOKEN"] = token
+    state = str(tmp_path / "shared_head_state.log")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        standby_port = s.getsockname()[1]
+    primary = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", "0", "--state", state, "--token", token],
+        stdout=subprocess.PIPE, text=True, env=env)
+    address = primary.stdout.readline().strip().rsplit(" ", 1)[-1]
+    standby = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_service",
+         "--port", str(standby_port), "--state", state,
+         "--token", token, "--standby-of", address],
+        stdout=subprocess.PIPE, text=True, env=env)
+    assert "standing by" in standby.stdout.readline()
+    addresses = f"{address},127.0.0.1:{standby_port}"
+    env["RAY_TPU_HEAD_ADDRESSES"] = addresses
+    return primary, standby, addresses, env
+
+
+@pytest.fixture
+def _head_pair_cleanup():
+    yield
+    os.environ.pop("RAY_TPU_CLUSTER_TOKEN", None)
+
+
+def test_matrix_headkill_x_task_fanout_survives(tmp_path,
+                                                _head_pair_cleanup):
+    """Cell (head SIGKILL × cluster fan-out): the head dies mid-flight
+    under a task fan-out across two node daemons. The steady-state
+    task plane is head-free (PR 10), the standby promotes, every
+    client fails over by epoch and re-registers — ALL tasks complete,
+    zero ref loss, the blackout is measured, and the killer's record
+    shows exactly one head kill."""
+    import subprocess
+    import sys
+
+    primary, standby, addresses, env = _spawn_head_pair(tmp_path)
+    nodes = []
+    try:
+        for _ in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.node_daemon",
+                 "--address", addresses, "--num-cpus", "2",
+                 "--worker-mode", "thread"],
+                stdout=subprocess.PIPE, text=True, env=env)
+            assert "joined" in p.stdout.readline()
+            nodes.append(p)
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=addresses)
+        w = ray_tpu._private.worker.global_worker()
+
+        @ray_tpu.remote
+        def work(i):
+            time.sleep(0.05)
+            return i * 2
+
+        warm = [work.remote(i) for i in range(4)]
+        assert ray_tpu.get(warm, timeout=60) == [0, 2, 4, 6]
+
+        killer = chaos.NodeKiller(
+            [chaos.head_kill_target(primary)],
+            seed=15, interval_s=(0.05, 0.1), max_kills=1)
+        refs = [work.remote(i) for i in range(40)]
+        with killer:
+            # The kill fires while the fan-out is in flight.
+            out = ray_tpu.get(refs, timeout=120)
+        assert out == [i * 2 for i in range(40)]
+        kills = [k for k in killer.kills if "error" not in k]
+        assert len(kills) == 1 and kills[0]["kind"] == "head"
+        assert primary.poll() is not None
+        # Post-failover control plane is live: epoch bumped, the
+        # promoted head answers, membership reconciled by re-join.
+        # (The blackout records on the first successful round trip
+        # AFTER the failover observation — up to one heartbeat tick
+        # later — so wait for it, not just for the observation.)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                w.head_client.failovers < 1
+                or w.head_client.last_blackout_s is None):
+            time.sleep(0.2)
+        assert w.head_client.failovers == 1
+        assert w.head_client.head_epoch == 2
+        assert w.head_client.last_blackout_s is not None
+        stats = w.head_client.head_stats()
+        assert stats["epoch"] == 2 and not stats["fenced"]
+        live = [n for n in w.head_client.node_list() if n["alive"]]
+        assert len(live) >= 2
+        # And the task plane still works END TO END on the new head —
+        # within the usual post-fault reconcile window (node event
+        # channels re-dial on their own cadence; a probe racing that
+        # retries like any client would).
+        deadline = time.monotonic() + 20
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                assert ray_tpu.get(work.remote(100), timeout=30) == 200
+                ok = True
+            except AssertionError:
+                raise
+            except Exception:  # noqa: BLE001 — pre-reconcile routing
+                time.sleep(0.5)
+        assert ok, "no node served a task after the promotion settled"
+    finally:
+        ray_tpu.shutdown()
+        for p in reversed(nodes + [standby, primary]):
+            p.kill()
+            p.wait(timeout=5)
+
+
+def test_matrix_headkill_x_scale_up_resumes(tmp_path,
+                                            _head_pair_cleanup):
+    """Cell (head SIGKILL × scale-up): the head dies the moment the
+    autoscaler's first node launch spawns — the launching daemon dials
+    into the blackout. The provider's bounded retry plus the inherited
+    standby list (RAY_TPU_HEAD_ADDRESSES) land the node on the
+    PROMOTED head, parked demand is preserved, and the episode
+    completes: mid-scale-up operations resume rather than orphan."""
+    import subprocess
+    import sys
+
+    from ray_tpu.autoscaler import (
+        ClusterAutoscaler,
+        LocalSubprocessProvider,
+        NodeTypeConfig,
+    )
+
+    primary, standby, addresses, env = _spawn_head_pair(tmp_path)
+    scaler = None
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=addresses)
+        GlobalConfig.set("autoscaler_launch_retries", 5)
+        GlobalConfig.set("autoscaler_launch_backoff_s", 0.3)
+        GlobalConfig.set("autoscaler_launch_grace_s", 30.0)
+        prov = LocalSubprocessProvider(
+            addresses, worker_mode="thread", env=env)
+        real_spawn = prov._spawn
+        spawned = []
+
+        def killing_spawn(node_type):
+            if not spawned:
+                # Head dies exactly as the first launch leaves the
+                # gate: the daemon cold-starts INTO the blackout.
+                killer = chaos.NodeKiller(
+                    [chaos.head_kill_target(primary)],
+                    seed=16, interval_s=(0.0, 0.01), max_kills=1)
+                killer.start()
+                time.sleep(0.3)
+                killer.stop()
+                assert [k for k in killer.kills if "error" not in k]
+            proc = real_spawn(node_type)
+            spawned.append(proc)
+            return proc
+
+        prov._spawn = killing_spawn
+        scaler = ClusterAutoscaler(
+            addresses,
+            [NodeTypeConfig("base", {"CPU": 2}, min_workers=0,
+                            max_workers=1)],
+            provider=prov, idle_timeout_s=3600.0,
+            update_interval_s=0.3)
+
+        @ray_tpu.remote
+        def work(x):
+            return x + 1
+
+        refs = [work.remote(i) for i in range(4)]
+        assert ray_tpu.get(refs, timeout=120) == [1, 2, 3, 4]
+        w = ray_tpu._private.worker.global_worker()
+        assert w.head_client.head_epoch == 2
+        summ = scaler.summary()
+        assert summ["managed_nodes"] == 1
+        assert any(e.get("joined") for e in summ["scale_events"])
+    finally:
+        GlobalConfig.reset()
+        if scaler is not None:
+            scaler.shutdown()
+        ray_tpu.shutdown()
+        for p in reversed([standby, primary]):
+            p.kill()
+            p.wait(timeout=5)
+
+
+def test_matrix_headkill_x_serve_stream_completes(tmp_path,
+                                                  _head_pair_cleanup):
+    """Cell (head SIGKILL × serve stream): token streams in flight when
+    the head dies must run to completion (the serve data plane is
+    head-free), and a NEW stream after promotion succeeds — the serve
+    controller rides the failed-over client without re-deploying."""
+    import threading
+
+    from ray_tpu import serve
+
+    primary, standby, addresses, env = _spawn_head_pair(tmp_path)
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=2, num_tpus=0, worker_mode="thread",
+                     address=addresses)
+        serve.start()
+
+        @serve.deployment(name="head_kill_stream", num_replicas=2)
+        class S:
+            def __call__(self, n):
+                for i in range(n):
+                    time.sleep(0.05)
+                    yield i
+
+        handle = serve.run(S.bind())
+        assert list(handle.options(stream=True).remote(3)) == [0, 1, 2]
+
+        results = []
+        errors = []
+
+        def stream(n=40):
+            try:
+                results.append(
+                    list(handle.options(stream=True).remote(n)))
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=stream) for _ in range(4)]
+        for t in threads:
+            t.start()
+        killer = chaos.NodeKiller(
+            [chaos.head_kill_target(primary)],
+            seed=17, interval_s=(0.1, 0.2), max_kills=1)
+        killer.start()
+        for t in threads:
+            t.join(120)
+        killer.stop()
+        assert [k for k in killer.kills if "error" not in k]
+        assert not errors, errors
+        assert results == [list(range(40))] * 4
+        # Post-promotion: a fresh stream through the same deployment.
+        w = ray_tpu._private.worker.global_worker()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and w.head_client.failovers < 1:
+            time.sleep(0.2)
+        assert w.head_client.head_epoch == 2
+        assert list(handle.options(stream=True).remote(5)) == \
+            [0, 1, 2, 3, 4]
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+        for p in reversed([standby, primary]):
+            p.kill()
+            p.wait(timeout=5)
+
+
 def _spawn_cluster(tmp_path, n_nodes=2, node_env=None):
     import subprocess
     import sys

@@ -321,8 +321,8 @@ def test_check_bench_requires_cluster_metric(tmp_path):
     # adds llm_serving.continuous_tokens_per_sec, PR 7 adds
     # llm_prefix.cached_tokens_per_sec, PR 8 adds
     # chaos_slo.p99_ttft_under_kill, PR 10 adds the ownership
-    # flatness headline, and PR 12 adds the elastic-episode TTFT to
-    # the required set).
+    # flatness headline, PR 12 adds the elastic-episode TTFT, and
+    # PR 15 adds the head-failover blackout to the required set).
     def _green(**over):
         rec = {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
                "streaming": {"backpressured_items_per_sec": 150.0},
@@ -330,7 +330,8 @@ def test_check_bench_requires_cluster_metric(tmp_path):
                "llm_prefix": {"cached_tokens_per_sec": 400.0},
                "chaos_slo": {"p99_ttft_under_kill": 30.0},
                "ownership": {"head_rpcs_per_1k_objects": 0.0},
-               "elastic_slo": {"p99_ttft_under_scale": 20.0}}
+               "elastic_slo": {"p99_ttft_under_scale": 20.0},
+               "head_failover": {"blackout_s": 1.5}}
         rec.update(over)
         return rec
 
@@ -339,6 +340,12 @@ def test_check_bench_requires_cluster_metric(tmp_path):
     # Missing the elastic-episode requirement (suite skipped) -> fails.
     _write("BENCH_pr03.json",
            _green(elastic_slo={"skipped": "spin-up failed"}))
+    assert check_bench.main(["--dir", str(tmp_path)]) == 1
+    # Missing the head-failover blackout (suite skipped / head never
+    # actually killed) -> fails: a record cannot silently drop the
+    # failover episode.
+    _write("BENCH_pr03.json",
+           _green(head_failover={"skipped": "standby never promoted"}))
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     # Flatness is an ABSOLUTE gate: a head back in the object plane
     # (nonzero marginal RPCs per 1k objects) fails even with no prior.

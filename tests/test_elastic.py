@@ -267,6 +267,233 @@ def test_reap_race_push_refuses_and_reroutes(head_proc):
             p.wait(timeout=5)
 
 
+def test_drain_raced_by_second_reap(head_proc):
+    """The ROADMAP item 5 race row, deterministic: two concurrent
+    idle-reap passes target the SAME node. Exactly one claims and
+    drains it (one drain, one terminate, one drained_nodes count); the
+    loser observes the cordon and backs off; the held object's bytes
+    are offloaded exactly once — no double ``object_offload``."""
+    import threading
+
+    from ray_tpu.autoscaler import (
+        ClusterAutoscaler,
+        LocalSubprocessProvider,
+        NodeTypeConfig,
+    )
+
+    ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                 address=head_proc)
+    w = ray_tpu._private.worker.global_worker()
+    scaler = ClusterAutoscaler(
+        head_proc,
+        [NodeTypeConfig("base", {"CPU": 2}, min_workers=1,
+                        max_workers=1)],
+        provider=LocalSubprocessProvider(
+            head_proc, worker_mode="thread", env=_spawn_env()),
+        idle_timeout_s=3600.0, update_interval_s=0.5)
+    try:
+        _wait_nodes(w.head_client, 1)
+
+        @ray_tpu.remote
+        def big(i):
+            return bytes(200_000) + bytes([i])
+
+        ref = big.remote(7)
+        router = w.remote_router
+        ob = ref.object_id.binary()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with router._lock:
+                if router._oid_owner.get(ob) is not None:
+                    break
+            time.sleep(0.05)
+        with scaler._lock:
+            victim = scaler._managed[0]
+
+        before_offloaded = router.offloaded_objects
+        outcomes = []
+
+        def reap():
+            outcomes.append(scaler._terminate(victim, drain=True))
+
+        t1 = threading.Thread(target=reap)
+        t2 = threading.Thread(target=reap)
+        t1.start()
+        t2.start()
+        t1.join(60)
+        t2.join(60)
+        # Exactly one pass claimed the node; the loser backed off.
+        assert sorted(outcomes) == [False, True], outcomes
+        summary = scaler.summary()
+        assert summary["drained_nodes"] == 1
+        assert summary["terminated"] == ["base"]
+        assert summary["managed_nodes"] == 0
+        # The bytes moved once: one offload, and the ref still resolves.
+        assert router.offloaded_objects == before_offloaded + 1
+        assert summary["drain_transferred_objects"] == 1
+        val = ray_tpu.get(ref, timeout=30)
+        assert val[-1] == 7 and len(val) == 200_001
+    finally:
+        scaler.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_drain_rpc_itself_is_exactly_once(head_proc):
+    """Node-side half of the race row: two CONCURRENT node_drain RPCs
+    against one node (two reapers that both got past their own claim
+    — e.g. two autoscalers). The first claims the cordon and runs the
+    lease transfer; the second answers ``already_draining`` with the
+    same counters and performs no second offload."""
+    import threading
+
+    ray_tpu.shutdown()
+    procs = []
+    try:
+        env = _spawn_env()
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_daemon",
+             "--address", head_proc, "--num-cpus", "2",
+             "--worker-mode", "thread"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(node)
+        assert "joined" in node.stdout.readline()
+        ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                     address=head_proc)
+        w = ray_tpu._private.worker.global_worker()
+        router = w.remote_router
+        live = _wait_nodes(w.head_client, 1)
+        node_client = live[0]["client_id"]
+
+        @ray_tpu.remote
+        def big():
+            return bytes(200_000)
+
+        ref = big.remote()
+        ob = ref.object_id.binary()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with router._lock:
+                if router._oid_owner.get(ob) is not None:
+                    break
+            time.sleep(0.05)
+        before = router.offloaded_objects
+        reports = []
+
+        def drain():
+            reports.append(dict(w.head_client.node_drain(
+                node_client, timeout=10.0)))
+
+        t1 = threading.Thread(target=drain)
+        t2 = threading.Thread(target=drain)
+        t1.start()
+        t2.start()
+        t1.join(30)
+        t2.join(30)
+        assert len(reports) == 2, reports
+        flags = sorted(r.get("already_draining", False)
+                       for r in reports)
+        assert flags == [False, True], reports
+        # One transfer of the one held object — never double-counted.
+        assert all(r["transferred"] == 1 for r in reports
+                   if not r.get("already_draining")), reports
+        assert router.offloaded_objects == before + 1
+        assert w.store.is_ready(ref.object_id)
+        assert len(ray_tpu.get(ref, timeout=30)) == 200_000
+    finally:
+        ray_tpu.shutdown()
+        for p in reversed(procs):
+            p.kill()
+            p.wait(timeout=5)
+
+
+class _BrownoutProvider:
+    """Provider decorator: every launch raises NodeLaunchFailedError
+    while the brown-out window is closed (the cloud's capacity outage
+    shape), then delegates once it lifts."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.window_open = False
+        self.browned_out_launches = 0
+
+    def launch(self, node_type):
+        if not self.window_open:
+            self.browned_out_launches += 1
+            raise NodeLaunchFailedError(
+                node_type.name, 1,
+                "provider brown-out: no capacity in any zone")
+        return self.inner.launch(node_type)
+
+    def terminate(self, handle):
+        return self.inner.terminate(handle)
+
+    def poll_alive(self, handle):
+        return self.inner.poll_alive(handle)
+
+    @property
+    def launch_attempts(self):
+        return self.inner.launch_attempts + self.browned_out_launches
+
+    @property
+    def launch_failures(self):
+        return self.inner.launch_failures + self.browned_out_launches
+
+
+def test_provider_brownout_demand_preserved_until_window_lifts(head_proc):
+    """The provider brown-out fault row: EVERY node launch fails for a
+    window (typed NodeLaunchFailedError, counted). Demand — parked
+    infeasible tasks — is preserved through the outage, and when the
+    window lifts the autoscaler's next tick launches for the SAME
+    demand and the episode completes."""
+    from ray_tpu.autoscaler import (
+        ClusterAutoscaler,
+        LocalSubprocessProvider,
+        NodeTypeConfig,
+    )
+
+    ray_tpu.init(num_cpus=0, num_tpus=0, worker_mode="thread",
+                 address=head_proc)
+    prov = _BrownoutProvider(LocalSubprocessProvider(
+        head_proc, worker_mode="thread", env=_spawn_env()))
+    scaler = ClusterAutoscaler(
+        head_proc,
+        [NodeTypeConfig("base", {"CPU": 2}, min_workers=0,
+                        max_workers=2)],
+        provider=prov, idle_timeout_s=3600.0, update_interval_s=0.3)
+    try:
+        @ray_tpu.remote
+        def work(x):
+            return x + 1
+
+        # Demand lands DURING the brown-out: infeasible here (0 CPUs),
+        # parked and advertised to the autoscaler via heartbeats.
+        refs = [work.remote(i) for i in range(4)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if scaler.launch_errors >= 2:
+                break
+            time.sleep(0.1)
+        assert scaler.launch_errors >= 2, \
+            "brown-out launches never surfaced typed"
+        assert prov.browned_out_launches >= 2
+        assert scaler.summary()["managed_nodes"] == 0
+        # Demand preserved: nothing completed, nothing was dropped.
+        with pytest.raises(GetTimeoutError):
+            ray_tpu.get(refs[0], timeout=0.2)
+
+        prov.window_open = True  # the outage lifts
+        assert ray_tpu.get(refs, timeout=90) == [i + 1 for i in range(4)]
+        summary = scaler.summary()
+        assert summary["managed_nodes"] >= 1
+        assert summary["launch_failures"] >= 2
+        # The launch that finally succeeded is recorded as a scale
+        # event with a join timestamp (cold-start SLO input).
+        assert any(e.get("joined") for e in summary["scale_events"])
+    finally:
+        scaler.shutdown()
+        ray_tpu.shutdown()
+
+
 # ------------------------------------------------------- scale-to-zero wake
 def test_scale_to_zero_then_wake_queues_not_sheds():
     """A deployment with min_replicas=0 drops to zero after the idle

@@ -387,6 +387,151 @@ def test_state_log_write_fence(tmp_path):
     successor.close()
 
 
+def test_head_epoch_bumps_per_incarnation(tmp_path):
+    """Every head boot over a state log is a new incarnation: the
+    epoch replays and bumps, survives compaction, and is advertised in
+    hello replies and head_stats (the wire half of the split-brain
+    fence — the flock protects the log file, this protects the wire)."""
+    from ray_tpu._private.head_service import HeadService, _StateLog
+
+    state = str(tmp_path / "state.log")
+    h1 = HeadService("127.0.0.1", 0, state_path=state)
+    try:
+        assert h1.epoch == 1
+        h1._persist("kv_put", b"e", b"1")
+        h1._compact()  # snapshot must carry the epoch forward
+    finally:
+        h1.shutdown()
+    h2 = HeadService("127.0.0.1", 0, state_path=state)
+    try:
+        assert h2.epoch == 2
+        stats_epoch = None
+        from ray_tpu._private.head_client import HeadClient
+        import threading as _threading
+
+        _threading.Thread(target=h2.serve_forever, daemon=True).start()
+        c = HeadClient(f"127.0.0.1:{h2.port}", token=h2.token)
+        try:
+            stats_epoch = c.head_stats()["epoch"]
+            assert c.head_epoch == 2  # hello reply carried it
+        finally:
+            c.close()
+        assert stats_epoch == 2
+    finally:
+        h2.shutdown()
+    # The log's replayed view agrees (epoch records + snapshot).
+    seen = [r[1] for r in _StateLog.replay(state) if r[0] == "epoch"]
+    assert max(seen) == 2
+
+
+def test_fenced_head_refuses_stale_writes():
+    """The epoch test from the acceptance criteria: a client gossiping
+    a NEWER head epoch on its heartbeat fences the old incarnation —
+    its post-promotion writes (and reads: its directories are stale)
+    refuse with a typed HeadFailedOverError, while heartbeats still
+    answer with the regressed epoch so stale-but-healthy connections
+    re-dial instead of trusting it."""
+    import threading
+
+    from ray_tpu._private import transport
+    from ray_tpu._private.head_service import HeadService
+
+    h = HeadService("127.0.0.1", 0)  # epoch 1 (no log)
+    threading.Thread(target=h.serve_forever, daemon=True).start()
+    try:
+        conn = transport.connect("127.0.0.1", h.port, h.token)
+        conn.send(("hello", "stale-client", "request"))
+        status, hello = conn.recv()
+        assert status == "ok" and hello["epoch"] == 1
+        assert not hello["fenced"]
+        # Pre-fence: writes land.
+        conn.send(("kv_put", b"w", b"1", True))
+        assert conn.recv() == ("ok", True)
+        # Gossip: this client has seen a promoted head at epoch 2.
+        conn.send(("heartbeat", {"_epoch": 2}))
+        status, beat = conn.recv()
+        assert status == "ok" and beat["epoch"] == 1 and beat["fenced"]
+        # Post-promotion write: refused typed at the wire.
+        conn.send(("kv_put", b"w", b"2", True))
+        status, err = conn.recv()
+        assert status == "err"
+        assert err["type"] == "HeadFailedOverError"
+        assert err["module"] == "ray_tpu.exceptions"
+        # Reads refuse too — the fenced head's directories are stale.
+        conn.send(("kv_get", b"w"))
+        assert conn.recv()[0] == "err"
+        assert h.fenced_refusals >= 2
+        # Heartbeats keep answering (the regression signal).
+        conn.send(("heartbeat", {}))
+        status, beat = conn.recv()
+        assert status == "ok" and beat["fenced"]
+        conn.close()
+        # A fresh dial is refused at hello time (fenced flag), so even
+        # an epoch-0 newcomer cannot attach to the dead incarnation.
+        from ray_tpu._private.head_client import HeadClient
+
+        with pytest.raises(ConnectionError):
+            HeadClient(f"127.0.0.1:{h.port}", token=h.token)
+    finally:
+        h.shutdown()
+
+
+def test_head_failover_replays_inflight_and_reregisters(tmp_path):
+    """Live failover, in-process: a client attached to
+    "primary,standby" sees the primary die mid-traffic. In-flight
+    idempotent RPCs replay against the promoted standby (shared log),
+    the epoch bump fires the re-registration callbacks, the blackout
+    (first refused RPC -> first promoted reply) is measured, and a
+    node's re-join reconciles membership on the promoted head."""
+    import socket
+    import threading
+
+    from ray_tpu._private.head_client import HeadClient
+    from ray_tpu._private.head_service import HeadService
+
+    state = str(tmp_path / "shared.log")
+    h1 = HeadService("127.0.0.1", 0, state_path=state)
+    threading.Thread(target=h1.serve_forever, daemon=True).start()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        standby_port = s.getsockname()[1]
+    client = HeadClient(
+        f"127.0.0.1:{h1.port},127.0.0.1:{standby_port}", token=h1.token)
+    h2 = None
+    try:
+        client.kv_put(b"fo", b"v")
+        client.node_register("nodeX", {"CPU": 2})
+        fired = threading.Event()
+        rejoined = []
+
+        def on_failover(old, new):
+            # The node-daemon-shaped hook: re-join announcement.
+            client.node_register("nodeX", {"CPU": 2})
+            rejoined.append((old, new))
+            fired.set()
+
+        client.failover_callbacks.append(on_failover)
+        h1.shutdown()  # the primary dies (no standby probe needed:
+        # the client's next RPC walks the address list itself)
+        h2 = HeadService("127.0.0.1", standby_port, token=h1.token,
+                         state_path=state)
+        threading.Thread(target=h2.serve_forever, daemon=True).start()
+        # In-flight RPC issued AFTER death, BEFORE any heartbeat tick
+        # notices: must replay against the promoted head.
+        assert client.kv_get(b"fo") == b"v"
+        assert client.head_epoch == 2
+        assert fired.wait(10), "failover callbacks never fired"
+        assert rejoined == [(1, 2)]
+        assert client.failovers == 1
+        assert client.last_blackout_s is not None
+        nodes = {n["node_id"] for n in client.node_list()}
+        assert "nodeX" in nodes  # replayed AND re-joined
+    finally:
+        client.close()
+        if h2 is not None:
+            h2.shutdown()
+
+
 def test_head_client_close_frees_data_plane(head_proc):
     """HeadClient.close() must shut down the direct object server and
     peer pool — the listener port is released, not leaked."""
