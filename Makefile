@@ -1,9 +1,9 @@
 PYTHON ?= python
 
 .PHONY: test analyze bench bench-control-plane bench-llm \
-	bench-llm-prefix bench-gate bench-chaos bench-ownership \
-	bench-elastic bench-failover bench-trace bench-flight \
-	chaos-gate debug-dump
+	bench-llm-prefix bench-disagg bench-gate bench-chaos \
+	bench-ownership bench-elastic bench-failover bench-trace \
+	bench-flight chaos-gate debug-dump
 
 test: analyze
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
@@ -36,6 +36,17 @@ bench-llm:
 # shared prefix blocks vs the caching-disabled engine. One JSON line.
 bench-llm-prefix:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite llm_prefix
+
+# Disaggregated prefill/decode serving + speculative decoding: p99 TTFT
+# under decode saturation, disagg (1 prefill + 1 decode replica, p2p KV
+# shipping) vs colocated (2 replicas) — the <= 0.7x ratio is asserted
+# in-suite (flight-recorder capture on miss) — plus spec-vs-vanilla
+# decode tokens/s (>= 1.3x, greedy parity asserted). One JSON line;
+# llm_disagg.p99_ttft_ratio is REQUIRED by check_bench.
+bench-disagg:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --suite llm_disagg
+	$(PYTHON) scripts/check_bench.py \
+		--require llm_disagg.p99_ttft_ratio
 
 # Chaos x load SLO probe: hundreds of concurrent token streams through
 # a 2-replica LLM deployment with a replica SIGKILLed mid-load and

@@ -343,3 +343,16 @@ _D("head_failover_wait_s", float, 20.0,
    "re-dials (standby promotion window) up to this bound before "
    "failing callers; non-replayable relays fail immediately with "
    "HeadFailedOverError.")
+_D("llm_kv_publish_ttl_s", float, 30.0,
+   "Disaggregated serving publish TTL: a prefill replica's exported KV "
+   "blocks (held for a decode replica's p2p pull) free automatically "
+   "this many seconds after publication if never acked — a crashed or "
+   "rerouted decode side can never leak prefill-pool blocks.")
+_D("llm_disagg_pull_timeout_s", float, 10.0,
+   "Disaggregated serving p2p pull bound: how long a decode replica "
+   "waits for a published KV payload before abandoning the graft and "
+   "transparently re-prefilling locally (typed fallback, not a hang).")
+_D("llm_disagg_prefill_timeout_s", float, 30.0,
+   "Disaggregated serving prefill RPC bound: how long the pairing "
+   "layer waits for a prefill replica's ticket before falling back to "
+   "the colocated path on the decode pool.")

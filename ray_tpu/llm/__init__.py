@@ -6,7 +6,9 @@ automatic prefix caching, chunked prefill, tensor-parallel decode).
 - ``PagedKVCache`` (kv_cache.py): fixed-size blocks in preallocated
   device arrays, per-sequence block tables, refcounted copy-on-write
   SHARED PREFIX BLOCKS (chain-hashed full blocks; a prompt whose prefix
-  is cached skips that prefill entirely), cached-free LRU tier.
+  is cached skips that prefill entirely), cached-free LRU tier, aux
+  pools riding the same tables (the spec-decode draft cache), and
+  block export/graft for p2p KV shipping.
 - ``Scheduler`` (scheduler.py): bounded-waitqueue admission in
   (priority, FIFO) order with LOAD SHEDDING — at capacity the worst
   class is evicted/refused with a typed ``RequestSheddedError`` —
@@ -14,27 +16,46 @@ automatic prefix caching, chunked prefill, tensor-parallel decode).
   can't stall the batch), recompute eviction on KV OOM.
 - ``InferenceEngine`` (engine.py): jitted chunk-prefill/decode step
   loop with streaming per-request token queues; ``tp_size`` shards the
-  model and the KV pool (along ``n_kv_heads``) across the mesh.
+  model and the KV pool (along ``n_kv_heads``) across the mesh;
+  ``spec_k``/``draft_model`` arm SPECULATIVE decoding (draft proposes
+  k tokens, the flagship verifies them in one multi-token step —
+  greedy output provably identical to vanilla decode).
 - ``build_llm_app`` (api.py): Serve deployment builder — token streams
   ride ``handle.options(stream=True)`` / chunked HTTP with per-request
   cancellation propagating to sequence-free; replicas report prefix
   digests the Serve router scores for cache-affinity routing.
+- ``build_disagg_llm_app`` (disagg.py): DISAGGREGATED prefill/decode
+  pools — prefill replicas publish finished prompts' KV blocks as
+  owner-resolved p2p objects (freed on decode-side ack or a bounded
+  TTL), decode replicas pull and graft them (tail-only past their own
+  cached prefix) and stream tokens; each pool autoscales on its own
+  saturation signal.
 """
 
 from ray_tpu.llm.api import LLMServer, build_llm_app
+from ray_tpu.llm.disagg import (
+    DecodeLLMServer,
+    DisaggHandle,
+    PrefillLLMServer,
+    build_disagg_llm_app,
+)
 from ray_tpu.llm.engine import EngineConfig, InferenceEngine, live_engines
 from ray_tpu.llm.kv_cache import KVCacheOOM, PagedKVCache, chain_digests
 from ray_tpu.llm.scheduler import EngineQueueFull, Request, Scheduler
 
 __all__ = [
+    "DecodeLLMServer",
+    "DisaggHandle",
     "EngineConfig",
     "EngineQueueFull",
     "InferenceEngine",
     "KVCacheOOM",
     "LLMServer",
     "PagedKVCache",
+    "PrefillLLMServer",
     "Request",
     "Scheduler",
+    "build_disagg_llm_app",
     "build_llm_app",
     "chain_digests",
     "live_engines",

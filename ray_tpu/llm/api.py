@@ -124,6 +124,19 @@ class LLMServer:
     def queue_depth(self) -> int:
         return self.engine.queue_depth()
 
+    def autoscale_metric(self, name: str) -> float:
+        """Custom autoscaling signal by name (the controller polls this
+        when ``AutoscalingConfig.metric`` names one): ``queue_depth`` —
+        prompts parked behind compute; ``kv_blocks_in_use`` — resident
+        sequences' cache footprint. Unknown names read 0.0 (a
+        misconfigured metric holds the pool steady instead of
+        flapping it)."""
+        if name == "queue_depth":
+            return float(self.engine.queue_depth())
+        if name == "kv_blocks_in_use":
+            return float(self.engine.cache.stats()["blocks_in_use"])
+        return 0.0
+
     def stats(self) -> Dict[str, Any]:
         out = dict(self.engine.stats())
         out.update({

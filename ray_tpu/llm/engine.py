@@ -104,6 +104,14 @@ class EngineConfig:
     cache_dtype: Any = None            # default: model dtype
     enable_prefix_caching: bool = True  # COW shared prefix blocks
     tp_size: int = 1                   # tensor-parallel mesh width
+    # Speculative decoding: a small DRAFT model proposes spec_k tokens
+    # per iteration and the flagship verifies them in ONE multi-token
+    # step (models.verify_step). spec_k=0 or draft_model=None disables
+    # it (vanilla decode). The draft's KV rides the same block tables
+    # as an aux pool. Greedy-only: a decode round containing any
+    # temperature>0 sequence falls back to vanilla for that round.
+    spec_k: int = 0
+    draft_model: Any = None            # draft TransformerConfig
 
     def resolved_model(self):
         if self.model is not None:
@@ -127,7 +135,8 @@ class InferenceEngine:
     deployment then serves identical weights with zero shipping)."""
 
     def __init__(self, config: Optional[EngineConfig] = None,
-                 params: Optional[dict] = None):
+                 params: Optional[dict] = None,
+                 draft_params: Optional[dict] = None):
         import jax
         from functools import partial
 
@@ -135,6 +144,7 @@ class InferenceEngine:
             decode_step,
             init_params,
             prefill_chunk,
+            verify_step,
         )
 
         self.config = config or EngineConfig()
@@ -170,15 +180,53 @@ class InferenceEngine:
             partial(decode_step, self.model_cfg, mesh=self.mesh,
                     rules=rules),
             donate_argnums=donate)
+        # Speculative decoding: jit the draft's prefill/decode and the
+        # flagship's multi-token verify; the draft KV pool attaches to
+        # the SAME block manager as an aux pool (one table, two pools).
+        self._spec_armed = (self.config.spec_k > 0
+                            and self.config.draft_model is not None)
+        if self._spec_armed:
+            if self.config.tp_size > 1:
+                raise ValueError(
+                    "speculative decoding is not supported with tp_size "
+                    "> 1 (the draft aux pool is unsharded)")
+            self.draft_cfg = self.config.draft_model
+            if draft_params is None:
+                draft_params = init_params(
+                    self.draft_cfg,
+                    jax.random.PRNGKey(self.config.param_seed + 1))
+            self.draft_params = draft_params
+            self.cache.attach_aux("draft", self.draft_cfg,
+                                  dtype=self.config.cache_dtype)
+            self._draft_prefill = jax.jit(
+                partial(prefill_chunk, self.draft_cfg),
+                donate_argnums=donate)
+            self._draft_decode = jax.jit(
+                partial(decode_step, self.draft_cfg),
+                donate_argnums=donate)
+            self._verify = jax.jit(
+                partial(verify_step, self.model_cfg, mesh=self.mesh,
+                        rules=rules),
+                donate_argnums=donate)
         self._lock = threading.RLock()          # scheduler + cache + step
         self._work = threading.Event()          # submit -> loop wakeup
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
         self._requests: Dict[int, Request] = {}
+        # Held-after-prefill sequences (disagg prefill pool): finished
+        # requests whose KV blocks stay allocated for p2p export until
+        # release_held() (decode-side ack) or the publish TTL fires.
+        self._held: Dict[int, Request] = {}
         # -- counters --
         self.num_steps = 0
         self.num_prefill_tokens = 0      # prompt tokens actually computed
         self.num_generated_tokens = 0
+        # -- speculative-decoding counters --
+        self.spec_rounds = 0             # verify steps run
+        self.spec_proposed = 0           # draft tokens proposed
+        self.spec_accepted = 0           # proposals the flagship accepted
+        self.spec_emitted = 0            # tokens emitted by spec rounds
+        self.spec_fallback_rounds = 0    # rounds vanilla-decoded instead
         # Per-request TTFT decomposition records (queue/prefill/decode/
         # ttft seconds), bounded: stats() serves percentile rollups —
         # the elastic episode's "where does TTFT live" evidence.
@@ -256,6 +304,8 @@ class InferenceEngine:
                     # request (reallocating blocks, streaming past DONE).
                     self.scheduler.remove_waiting(req)
                     self._finish(req, CANCELLED)
+            for seq_id in list(self._held):
+                self.release_held(seq_id)
         self._work.set()
 
     def _loop(self):
@@ -298,7 +348,8 @@ class InferenceEngine:
                temperature: float = 0.0,
                seed: Optional[int] = None,
                priority: int = 0,
-               trace=None) -> Request:
+               trace=None,
+               hold_after_prefill: bool = False) -> Request:
         """Enqueue a request. Past the bounded waitqueue the LOWEST
         priority class loses: either this submit raises
         ``EngineQueueFull`` (a ``RequestSheddedError``) or a worse
@@ -314,6 +365,7 @@ class InferenceEngine:
                           else self.config.eos_token_id),
             temperature=temperature, seed=seed, priority=priority)
         req.trace = trace
+        req.hold_after_prefill = bool(hold_after_prefill)
         # Reject what can NEVER be served: a completion longer than the
         # model's context window, or one larger than the whole pool.
         # (Prompts over the prefill token budget are FINE — chunked
@@ -401,6 +453,145 @@ class InferenceEngine:
         else:
             req.output_queue.put((_DONE, status))
 
+    def _hold(self, req: Request):
+        """Disagg prefill pool: retire a ``hold_after_prefill`` request
+        WITHOUT freeing its KV blocks — they stay allocated (and
+        prefix-registered) for p2p export until ``release_held`` (the
+        decode side's ack) or the publish TTL sweeps them. Consumer-
+        visible stream behavior is identical to ``_finish``."""
+        self.scheduler.release(req, FINISHED, free_blocks=False)
+        self._requests.pop(req.seq_id, None)
+        self._held[req.seq_id] = req
+        req.t_finish = time.monotonic()
+        self._record_timing(req, FINISHED)
+        req.output_queue.put((_DONE, FINISHED))
+
+    def release_held(self, seq_id: int) -> int:
+        """Free a held sequence's blocks (decode-side ack, TTL expiry,
+        or shutdown). Idempotent — ack and the TTL sweep may race; the
+        loser sees 0. Returns blocks actually freed."""
+        with self._lock:
+            if self._held.pop(seq_id, None) is None:
+                return 0
+            freed = self.cache.free(seq_id)
+        self._work.set()  # a parked admission may now fit
+        return freed
+
+    def held_count(self) -> int:
+        with self._lock:
+            return len(self._held)
+
+    # ------------------------------------------------------ disagg adoption
+    def begin_adopted(self, prompt: List[int],
+                      max_new_tokens: Optional[int] = None,
+                      eos_token_id: Optional[int] = None,
+                      temperature: float = 0.0,
+                      seed: Optional[int] = None,
+                      priority: int = 0,
+                      trace=None) -> Optional[Request]:
+        """Disagg decode pool, step 1 of 3: allocate the prompt's block
+        table as admission would (sharing every prefix-cached leading
+        block) so a prefill replica's exported KV can be grafted into
+        it. Returns None when the batch or pool has no room RIGHT NOW —
+        adoption is an optimization, never a queueing state; the caller
+        falls back to the colocated path. The returned request is
+        cancellable and shutdown-safe like any other, but runs only
+        after ``commit_adopted``."""
+        req = Request(
+            prompt,
+            max_new_tokens if max_new_tokens is not None
+            else self.config.max_new_tokens_default,
+            eos_token_id=(eos_token_id if eos_token_id is not None
+                          else self.config.eos_token_id),
+            temperature=temperature, seed=seed, priority=priority)
+        req.trace = trace
+        total = len(req.prompt) + req.max_new_tokens
+        max_len = getattr(self.model_cfg, "max_seq_len", None)
+        if max_len is not None and total > max_len:
+            return None
+        with self._lock:
+            if len(self.scheduler.running) >= self.config.max_num_seqs:
+                return None
+            cached = self.cache.allocate_prefix(
+                req.seq_id, req.prompt, extra_tokens=1)
+            if cached is None:
+                return None
+            req.cached_prompt_tokens = cached
+            req.t_sched = time.monotonic()
+            self._requests[req.seq_id] = req
+        return req
+
+    def abort_adopted(self, req: Request) -> None:
+        """Undo ``begin_adopted`` (the remote prefill or the p2p pull
+        failed): drop the allocation and forget the request. The caller
+        retries on the colocated path with a FRESH submit."""
+        with self._lock:
+            self._requests.pop(req.seq_id, None)
+            self.cache.free(req.seq_id)
+        self._work.set()
+
+    def adopt_kv(self, req: Request, payload: dict) -> bool:
+        """Disagg step 2: graft the prefill replica's exported blocks
+        into this pool under the adopted sequence's table. Blocks
+        before the locally prefix-cached boundary are NEVER written
+        (they are shared with their other holders); the payload must
+        cover everything from that boundary on or the graft is refused
+        (False — the shipping plan went stale, caller falls back). On
+        success the full prompt registers in the prefix cache and the
+        transfer phase stamp closes."""
+        graft_from = req.cached_prompt_tokens // self.cache.block_size
+        if (int(payload.get("block_size", -1)) != self.cache.block_size
+                or int(payload.get("start_block", 0)) > graft_from):
+            return False
+        with self._lock:
+            try:
+                self.cache.graft_blocks(req.seq_id, payload,
+                                        start_block=graft_from)
+            except (KeyError, ValueError):
+                return False
+            self.cache.register_prefix(req.seq_id, len(req.prompt))
+        nbytes = 0
+        for part in (payload, *payload.get("aux", {}).values()):
+            for name in ("k", "v"):
+                arr = part.get(name)
+                if arr is not None:
+                    nbytes += int(getattr(arr, "nbytes", 0))
+        req.kv_ship = (int(payload.get("blocks", 0)), nbytes)
+        now = time.monotonic()
+        if req.t_prefill_done is None:
+            # The caller normally stamps this when the remote prefill
+            # RPC returns; backfill keeps transfer_s >= 0 regardless.
+            req.t_prefill_done = now
+        req.t_transfer_done = now
+        return True
+
+    def commit_adopted(self, req: Request, first_token: int) -> None:
+        """Disagg step 3: the grafted sequence becomes a live decode
+        row. Streams the prefill replica's first token (sampled there
+        from the final chunk's logits — identical to the colocated
+        path) and joins the running set at the decode phase; EOS or a
+        1-token budget finishes immediately."""
+        tok = int(first_token)
+        with self._lock:
+            now = time.monotonic()
+            if req.t_prefill_done is None:
+                req.t_prefill_done = now
+            if req.t_transfer_done is None:
+                req.t_transfer_done = now
+            req.prefill_pos = len(req.prompt)
+            req.t_first_token = now
+            req.out_tokens.append(tok)
+            self.num_generated_tokens += 1
+            req.output_queue.put(tok)
+            if ((req.eos_token_id is not None
+                    and tok == req.eos_token_id)
+                    or len(req.out_tokens) >= req.max_new_tokens):
+                self._finish(req, FINISHED)
+                return
+            self.scheduler.adopt_running(req)
+            self._work.set()
+        self._ensure_loop()
+
     def _record_timing(self, req: Request, status: str):
         """TTFT decomposition record + (when the request carried a trace
         context) llm.queue / llm.prefill / llm.decode spans with a
@@ -411,12 +602,21 @@ class InferenceEngine:
         prefill_s = ((req.t_prefill_done - req.t_sched)
                      if req.t_sched is not None
                      and req.t_prefill_done is not None else 0.0)
-        decode_s = ((t_end - req.t_prefill_done)
-                    if req.t_prefill_done is not None else 0.0)
+        # Disagg-adopted sequences add a TRANSFER phase (p2p KV pull +
+        # graft) between prefill and decode; colocated requests have
+        # none and their decode starts at t_prefill_done.
+        transfer_s = ((req.t_transfer_done - req.t_prefill_done)
+                      if req.t_transfer_done is not None
+                      and req.t_prefill_done is not None else 0.0)
+        t_decode0 = (req.t_transfer_done
+                     if req.t_transfer_done is not None
+                     else req.t_prefill_done)
+        decode_s = (t_end - t_decode0) if t_decode0 is not None else 0.0
         self._timings.append({
             "status": status,
             "queue_s": queue_s,
             "prefill_s": prefill_s,
+            "transfer_s": transfer_s,
             "decode_s": decode_s,
             "ttft_s": ((req.t_first_token - req.t_submit)
                        if req.t_first_token is not None else None),
@@ -445,12 +645,19 @@ class InferenceEngine:
                        component="llm",
                        tags={"seq": req.seq_id,
                              "cached_tokens": req.cached_prompt_tokens})
+                if req.t_transfer_done is not None:
+                    blocks, nbytes = req.kv_ship or (0, 0)
+                    t.emit(ctx.trace_id, tracing._new_id(), ctx.span_id,
+                           "llm.kv_ship", wall(req.t_prefill_done),
+                           transfer_s, component="llm",
+                           tags={"seq": req.seq_id, "blocks": blocks,
+                                 "bytes": nbytes})
                 events = []
                 if req.t_first_token is not None:
                     events.append([wall(req.t_first_token),
                                    "first_token"])
                 t.emit(ctx.trace_id, tracing._new_id(), ctx.span_id,
-                       "llm.decode", wall(req.t_prefill_done), decode_s,
+                       "llm.decode", wall(t_decode0), decode_s,
                        status=ok, component="llm",
                        tags={"seq": req.seq_id,
                              "tokens": len(req.out_tokens)},
@@ -496,7 +703,10 @@ class InferenceEngine:
             if decodes:
                 decodes = [r for r in decodes if not r.finished()]
             if decodes:
-                self._run_decode(decodes)
+                if self._spec_armed:
+                    self._run_spec_decode(decodes)
+                else:
+                    self._run_decode(decodes)
             self.num_steps += 1
             return True
 
@@ -526,6 +736,15 @@ class InferenceEngine:
         logits, self.cache.data = self._prefill_chunk(
             self.params, self.cache.data, jnp.asarray(tokens),
             jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(bt))
+        if self._spec_armed:
+            # The draft's KV rides the SAME chunk plan into its aux
+            # pool — after prefill both models hold the prompt's cache
+            # and the first spec round can draft immediately.
+            _, draft_data = self._draft_prefill(
+                self.draft_params, self.cache.aux_data("draft"),
+                jnp.asarray(tokens), jnp.asarray(starts),
+                jnp.asarray(lens), jnp.asarray(bt))
+            self.cache.set_aux_data("draft", draft_data)
         logits = None if not any(
             start + n >= len(r.prompt) for r, start, n in chunks) \
             else np.asarray(logits)
@@ -564,6 +783,111 @@ class InferenceEngine:
             jnp.asarray(positions), jnp.asarray(bt))
         self._emit(reqs, np.asarray(logits)[:len(reqs)])
 
+    def _run_spec_decode(self, reqs: List[Request]):
+        """One SPECULATIVE round: the draft proposes ``spec_k`` greedy
+        tokens per sequence (its KV riding the shared block tables in
+        the aux pool), the flagship scores ``[last_token, d_1..d_k]``
+        in ONE ``verify_step``, and the longest agreeing prefix plus
+        one bonus token from the verify logits commits — 1 to k+1
+        tokens per sequence per iteration, token-for-token identical
+        to vanilla greedy decode (the flagship's argmax is always the
+        authority; the draft only picks how many positions one step
+        scores).
+
+        Fallback to a vanilla round (counted) when any row samples at
+        temperature > 0 (spec is greedy-only) or the k lookahead slots
+        don't all allocate. Stale lookahead KV past an accepted prefix
+        is masked by context length until the NEXT round's writes —
+        which always cover it — land (see ``verify_step``)."""
+        k = self.config.spec_k
+        if any(r.temperature > 0.0 for r in reqs):
+            self.spec_fallback_rounds += 1
+            return self._run_decode(reqs)
+        # schedule() guaranteed position num_tokens-1 (+1 headroom);
+        # verify also writes num_tokens .. num_tokens+k-1.
+        for r in reqs:
+            for pos in range(r.num_tokens, r.num_tokens + k):
+                if not self.cache.ensure_slot(r.seq_id, pos):
+                    self.spec_fallback_rounds += 1
+                    return self._run_decode(reqs)
+        import jax.numpy as jnp
+
+        bs = self.cache.block_size
+        b = len(reqs)
+        b_pad = _pow2_at_least(b)
+        c_pad = _pow2_at_least(k + 1)
+        tables = self.cache.padded_tables([r.seq_id for r in reqs])
+        # Cover every position verify's padded columns may touch —
+        # block lookups CLAMP to the last table column, so positions
+        # past a row's real table must resolve to the zero (NULL) pad,
+        # never onto its last live block.
+        need_m = max((r.num_tokens - 1 + c_pad - 1) // bs + 1
+                     for r in reqs)
+        m_pad = _pow2_at_least(max(tables.shape[1], need_m))
+        bt = np.zeros((b_pad, m_pad), np.int32)
+        bt[:b, :tables.shape[1]] = tables
+        bt_j = jnp.asarray(bt)
+
+        # Draft pass: k sequential one-token steps over the aux pool.
+        draft_data = self.cache.aux_data("draft")
+        proposals = np.zeros((b, k), np.int32)
+        cur = np.zeros((b_pad,), np.int32)
+        pos = np.zeros((b_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            cur[i] = r.last_token
+        for j in range(k):
+            for i, r in enumerate(reqs):
+                pos[i] = r.num_tokens - 1 + j
+            logits, draft_data = self._draft_decode(
+                self.draft_params, draft_data, jnp.asarray(cur),
+                jnp.asarray(pos), bt_j)
+            nxt = np.argmax(np.asarray(logits)[:b], axis=-1)
+            proposals[:, j] = nxt
+            cur[:b] = nxt
+        self.cache.set_aux_data("draft", draft_data)
+
+        # Verify pass: one flagship step scores all k proposals.
+        vtok = np.zeros((b_pad, c_pad), np.int32)
+        starts = np.zeros((b_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            vtok[i, 0] = r.last_token
+            vtok[i, 1:k + 1] = proposals[i]
+            starts[i] = r.num_tokens - 1
+        logits, self.cache.data = self._verify(
+            self.params, self.cache.data, jnp.asarray(vtok),
+            jnp.asarray(starts), bt_j)
+        logits = np.asarray(logits)[:b, :k + 1]
+
+        self.spec_rounds += 1
+        self.spec_proposed += b * k
+        for i, req in enumerate(reqs):
+            row = logits[i]
+            accepted = 0
+            while accepted < k and int(np.argmax(row[accepted])) \
+                    == int(proposals[i, accepted]):
+                accepted += 1
+            self.spec_accepted += accepted
+            # Accepted proposals + one bonus token (the flagship's own
+            # next token after the accepted prefix) — exactly what
+            # sequential greedy decode would have produced.
+            toks = [int(proposals[i, j]) for j in range(accepted)]
+            toks.append(int(np.argmax(row[accepted])))
+            if req.t_first_token is None:
+                req.t_first_token = time.monotonic()
+            for tok in toks:
+                req.out_tokens.append(tok)
+                self.num_generated_tokens += 1
+                self.spec_emitted += 1
+                req.output_queue.put(tok)
+                if ((req.eos_token_id is not None
+                        and tok == req.eos_token_id)
+                        or len(req.out_tokens) >= req.max_new_tokens):
+                    if req.hold_after_prefill:
+                        self._hold(req)
+                    else:
+                        self._finish(req, FINISHED)
+                    break
+
     def _emit(self, reqs: List[Request], logits: np.ndarray):
         """Sample one token per request from its logits row, stream it,
         and retire sequences that hit EOS / their token budget."""
@@ -576,7 +900,10 @@ class InferenceEngine:
             req.output_queue.put(tok)
             if ((req.eos_token_id is not None and tok == req.eos_token_id)
                     or len(req.out_tokens) >= req.max_new_tokens):
-                self._finish(req, FINISHED)
+                if req.hold_after_prefill:
+                    self._hold(req)
+                else:
+                    self._finish(req, FINISHED)
 
     @staticmethod
     def _sample(req: Request, row: np.ndarray) -> int:
@@ -604,7 +931,19 @@ class InferenceEngine:
             "prefill_tokens": self.num_prefill_tokens,
             "generated_tokens": self.num_generated_tokens,
             "ttft_decomposition": self.ttft_decomposition(),
+            "held_sequences": len(self._held),
         }
+        if self._spec_armed:
+            out["spec"] = {
+                "k": self.config.spec_k,
+                "rounds": self.spec_rounds,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "emitted": self.spec_emitted,
+                "fallback_rounds": self.spec_fallback_rounds,
+                "acceptance_rate": (self.spec_accepted
+                                    / max(1, self.spec_proposed)),
+            }
         out.update(self.scheduler.stats())
         out.update(self.cache.stats())
         return out
@@ -618,7 +957,8 @@ class InferenceEngine:
             return {"completed": 0}
 
         def pct(key, q):
-            vals = sorted(r[key] for r in rows if r[key] is not None)
+            vals = sorted(r[key] for r in rows
+                          if r.get(key) is not None)
             if not vals:
                 return None
             return vals[min(len(vals) - 1, int(len(vals) * q))]
@@ -629,6 +969,8 @@ class InferenceEngine:
             "queue_p99_s": pct("queue_s", 0.99),
             "prefill_p50_s": pct("prefill_s", 0.5),
             "prefill_p99_s": pct("prefill_s", 0.99),
+            "transfer_p50_s": pct("transfer_s", 0.5),
+            "transfer_p99_s": pct("transfer_s", 0.99),
             "decode_p50_s": pct("decode_s", 0.5),
             "decode_p99_s": pct("decode_s", 0.99),
             "ttft_p50_s": pct("ttft_s", 0.5),

@@ -126,6 +126,12 @@ class PagedKVCache:
         self._registered_upto: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._block_copy = None  # lazily-jitted COW block copy
+        # Aux pools (e.g. the spec-decode DRAFT model's KV) ride the
+        # SAME block tables/refcounts: one host-side manager, N device
+        # pools. Every lifecycle event that moves bytes (COW copy,
+        # export, graft) covers every pool, so a sequence's draft cache
+        # can never diverge from its flagship cache's block layout.
+        self._aux: Dict[str, Dict[str, object]] = {}
         # -- accounting (engine tests/bench read these) --
         self.peak_blocks_in_use = 0
         self.total_blocks_allocated = 0
@@ -137,6 +143,9 @@ class PagedKVCache:
         self.prefill_tokens_saved = 0      # tokens skipped via cache hits
         self.cow_copies = 0                # shared blocks copied on write
         self.cached_blocks_evicted = 0     # cached-free blocks reclaimed
+        # -- disagg p2p shipping counters --
+        self.blocks_exported = 0           # blocks packed for p2p publish
+        self.blocks_grafted = 0            # p2p blocks scattered back in
 
     # ------------------------------------------------------------- capacity
     @property
@@ -356,8 +365,12 @@ class PagedKVCache:
 
         s = jnp.int32(src)
         d = jnp.int32(dst)
-        for name in ("k", "v"):
-            self.data[name] = self._block_copy(self.data[name], s, d)
+        # Aux pools (draft KV) share the block layout, so a COW must
+        # copy EVERY pool — a draft cache left pointing at the donor
+        # block would silently read another sequence's context.
+        for pool in (self.data, *self._aux.values()):
+            for name in ("k", "v"):
+                pool[name] = self._block_copy(pool[name], s, d)
 
     def ensure_slot(self, seq_id: int, position: int) -> bool:
         """Grow ``seq_id``'s table so ``position`` has a physical slot
@@ -396,6 +409,117 @@ class PagedKVCache:
             if not blocks:
                 return 0
             return sum(self._release_block(b) for b in reversed(blocks))
+
+    # ------------------------------------------------- aux pools + shipping
+    def attach_aux(self, name: str, model_cfg, dtype=None) -> None:
+        """Attach a second device pool (same ``num_blocks`` ×
+        ``block_size`` geometry, possibly a different model config —
+        the spec-decode DRAFT cache) that rides this manager's block
+        tables. Aux pools are copied on COW, packed by
+        ``export_blocks`` and scattered by ``graft_blocks``."""
+        if self.mesh is not None:
+            raise ValueError("aux pools are not supported under tensor "
+                             "parallelism")
+        from ray_tpu.models import init_kv_cache
+
+        with self._lock:
+            if name in self._aux:
+                raise ValueError(f"aux pool {name!r} already attached")
+            self._aux[name] = init_kv_cache(
+                model_cfg, self.num_blocks, self.block_size, dtype)
+
+    def aux_data(self, name: str):
+        return self._aux[name]
+
+    def set_aux_data(self, name: str, data) -> None:
+        self._aux[name] = data
+
+    def export_blocks(self, seq_id: int, start_block: int = 0) -> dict:
+        """Pack ``seq_id``'s block data from ``start_block`` on into a
+        host-side payload (per-layer block ranges for every pool) —
+        what a disagg prefill replica publishes as an owner-resolved
+        p2p object. ``start_block`` implements tail-only shipping: a
+        decode replica whose prefix cache already holds the leading
+        blocks asks only for the unshared remainder.
+
+        Device arrays are immutable values, so the gather runs outside
+        the lock against a snapshot reference — a concurrent step's
+        functional cache update cannot corrupt the export."""
+        with self._lock:
+            table = list(self._tables[seq_id])
+            data = self.data
+            aux = {n: dict(p) for n, p in self._aux.items()}
+        blocks = table[start_block:]
+        payload = {
+            "start_block": int(start_block),
+            "blocks": len(blocks),
+            "block_size": self.block_size,
+        }
+        if blocks:
+            import jax.numpy as jnp
+
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            payload["k"] = np.asarray(data["k"][:, idx])
+            payload["v"] = np.asarray(data["v"][:, idx])
+            payload["aux"] = {
+                n: {"k": np.asarray(p["k"][:, idx]),
+                    "v": np.asarray(p["v"][:, idx])}
+                for n, p in aux.items()
+            }
+        with self._lock:
+            self.blocks_exported += len(blocks)
+        return payload
+
+    def graft_blocks(self, seq_id: int, payload: dict,
+                     start_block: Optional[int] = None) -> int:
+        """Scatter a peer's exported block payload into ``seq_id``'s
+        table, starting at ``start_block`` (default: the payload's own
+        start). A graft start past the payload's start skips leading
+        payload blocks — the decode replica's prefix cache covered more
+        than the shipping plan assumed, and shared blocks must NEVER be
+        written. Every target block is asserted privately owned and
+        unregistered. Returns blocks grafted.
+
+        Callers serialize against the engine step loop (the engine
+        grafts under its step lock): the scatter is a read-modify-write
+        of the pool arrays and must not interleave with a step's own
+        functional update."""
+        if int(payload["block_size"]) != self.block_size:
+            raise ValueError(
+                f"payload block_size {payload['block_size']} != pool "
+                f"block_size {self.block_size}")
+        src_start = int(payload["start_block"])
+        n = int(payload["blocks"])
+        sb = src_start if start_block is None else int(start_block)
+        off = sb - src_start
+        if off < 0:
+            raise ValueError(
+                f"graft start {sb} precedes payload start {src_start}")
+        with self._lock:
+            table = self._tables[seq_id]
+            dst = table[sb:src_start + n]
+            if not dst:
+                return 0
+            for b in dst:
+                if self._ref.get(b, 0) != 1 or b in self._block_key:
+                    raise ValueError(
+                        f"graft target block {b} is shared or "
+                        f"registered — grafting would corrupt another "
+                        f"sequence's context")
+            import jax.numpy as jnp
+
+            idx = jnp.asarray(np.asarray(dst, np.int32))
+            sl = slice(off, off + len(dst))
+            for pool, part in [(self.data, payload)] + [
+                    (self._aux[a], p)
+                    for a, p in payload.get("aux", {}).items()
+                    if a in self._aux]:
+                for name in ("k", "v"):
+                    arr = jnp.asarray(part[name][:, sl],
+                                      pool[name].dtype)
+                    pool[name] = pool[name].at[:, idx].set(arr)
+            self.blocks_grafted += len(dst)
+            return len(dst)
 
     # -------------------------------------------------------- prefix cache
     def register_prefix(self, seq_id: int, upto_tokens: int) -> int:
@@ -484,4 +608,7 @@ class PagedKVCache:
                 "prefix_cache_hit_rate": (saved / seen) if seen else 0.0,
                 "cow_copies": self.cow_copies,
                 "cached_blocks_evicted": self.cached_blocks_evicted,
+                "blocks_exported": self.blocks_exported,
+                "blocks_grafted": self.blocks_grafted,
+                "aux_pools": list(self._aux),
             }

@@ -89,9 +89,18 @@ class Request:
         self.wall_submit = _time.time()
         self.t_sched: Optional[float] = None
         self.t_prefill_done: Optional[float] = None
+        # Disagg adoption stamp: when the sequence's prompt KV was
+        # pulled p2p and grafted (transfer phase = t_transfer_done -
+        # t_prefill_done); None for colocated requests.
+        self.t_transfer_done: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_finish: Optional[float] = None
         self.trace = None  # tracing wire context ((trace_id, span_id))
+        # Disagg prefill pool: keep KV blocks allocated after the last
+        # prefill token (for p2p export) instead of freeing on finish.
+        self.hold_after_prefill = False
+        # (blocks, bytes) shipped for this sequence — llm.kv_ship span.
+        self.kv_ship: Optional[Tuple[int, int]] = None
         # Prompt tokens whose KV is in the cache (prefix-cache hits at
         # admission + chunks computed so far). The request decodes only
         # once this reaches len(prompt).
@@ -318,16 +327,32 @@ class Scheduler:
         with self._lock:
             self.waiting.appendleft(req)
 
+    def adopt_running(self, req: Request) -> None:
+        """Join an externally-prefilled (disagg-adopted) sequence to the
+        running set: its prompt KV was grafted from a prefill replica
+        and its first token already streamed, so it enters directly at
+        the decode phase. May transiently push the running set one past
+        ``max_num_seqs``; admission (which checks the cap) simply
+        pauses until a slot frees."""
+        req.status = RUNNING
+        self.running.append(req)
+        self.num_admitted += 1
+
     # -------------------------------------------------------------- release
     def release(self, req: Request, status: str,
-                error: Optional[BaseException] = None) -> int:
+                error: Optional[BaseException] = None,
+                free_blocks: bool = True) -> int:
         """Terminal transition: mark + drop block refs IMMEDIATELY (only
         refcount-0 blocks actually free — shared prefix blocks stay with
         their other holders). Safe to call for any state; returns blocks
-        freed."""
+        freed. ``free_blocks=False`` keeps the block table alive past
+        the terminal transition — the disagg prefill pool's hold-for-
+        export path, balanced by ``InferenceEngine.release_held``."""
         req.status = status
         req.error = error
         self.running = [r for r in self.running if r is not req]
+        if not free_blocks:
+            return 0
         return self.cache.free(req.seq_id)
 
     def stats(self) -> Dict[str, Any]:

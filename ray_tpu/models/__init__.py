@@ -5,6 +5,7 @@ The reference ships model code through RLlib modules and Train integrations
 directly into the parallelism layer (dp/pp/tp/sp/ep over one Mesh).
 """
 
+from ray_tpu.models.draft import draft_config, shift_params
 from ray_tpu.models.transformer import (
     TransformerConfig,
     decode_step,
@@ -16,11 +17,13 @@ from ray_tpu.models.transformer import (
     param_specs,
     prefill_chunk,
     prefill_with_cache,
+    verify_step,
 )
 
 __all__ = [
     "TransformerConfig",
     "decode_step",
+    "draft_config",
     "forward",
     "init_kv_cache",
     "init_params",
@@ -29,4 +32,6 @@ __all__ = [
     "param_specs",
     "prefill_chunk",
     "prefill_with_cache",
+    "shift_params",
+    "verify_step",
 ]

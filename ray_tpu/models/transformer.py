@@ -664,6 +664,21 @@ def prefill_chunk(cfg: TransformerConfig, params, cache,
     meaningful only for rows whose chunk completes the prompt — and the
     new cache).
     """
+    x, ck, cv = _chunk_scan(cfg, params, cache, tokens, start_pos,
+                            block_tables, mesh, rules)
+    last = jnp.take_along_axis(
+        x, (chunk_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
+    logits = (last @ params["lm_head"].astype(cfg.dtype)
+              ).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def _chunk_scan(cfg: TransformerConfig, params, cache, tokens, start_pos,
+                block_tables, mesh, rules):
+    """Shared multi-token body of ``prefill_chunk`` and ``verify_step``:
+    run the chunk through every layer against the paged cache, writing
+    each position's K/V before it is attended, and return the final-
+    normed hidden states ``[B, C, D]`` plus the updated K/V pools."""
     B, C = tokens.shape
     dt = cfg.dtype
     block_size = cache["k"].shape[2]
@@ -702,10 +717,37 @@ def prefill_chunk(cfg: TransformerConfig, params, cache,
     idxs = jnp.arange(cfg.n_layers)
     (x, ck, cv), _ = lax.scan(
         body, (x, cache["k"], cache["v"]), (params["layers"], idxs))
-    x = rms_norm(x, params["final_norm"])
-    last = jnp.take_along_axis(
-        x, (chunk_lens - 1)[:, None, None].clip(0), axis=1)[:, 0]
-    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"]), ck, cv
+
+
+def verify_step(cfg: TransformerConfig, params, cache,
+                tokens: jax.Array, start_pos: jax.Array,
+                block_tables: jax.Array, mesh=None, rules=None
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Speculative-decode VERIFY: advance each sequence by ``C`` tokens
+    in ONE program and return the logits at EVERY position — the
+    chunked-prefill multi-token path generalized from last-position
+    logits to all-position logits, so the flagship can score a draft
+    model's k proposals (positions carry token i's context -> logits
+    for token i+1) in a single batched step instead of k decode steps.
+
+    tokens [B, C] int32 — row b holds the verified context's last
+    accepted token followed by the draft's proposals, starting at
+    absolute position ``start_pos[b]``; block_tables as in
+    ``prefill_chunk`` (padded rows aim at the NULL block).
+
+    Returns (logits [B, C, vocab] f32, new cache). K/V for ALL C
+    positions is written — including positions whose draft token is
+    later REJECTED. That is safe by the same invariant chunked prefill
+    relies on: each layer writes a position's K/V before any later
+    position attends, and the engine always overwrites a rejected
+    position's slot (with the corrected token's K/V) before any
+    subsequent step attends over it.
+    """
+    x, ck, cv = _chunk_scan(cfg, params, cache, tokens, start_pos,
+                            block_tables, mesh, rules)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)
+              ).astype(jnp.float32)
     return logits, {"k": ck, "v": cv}
 
 

@@ -34,6 +34,18 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    # Scaling signal. The default scales on the router's per-replica
+    # in-flight counts; any other name is polled FROM the replicas
+    # (``autoscale_metric(name)``, off the request path on the
+    # telemetry thread) and averaged — disaggregated pools scale each
+    # on their own saturation signal ("queue_depth" for a prefill
+    # pool's parked prompts, "kv_blocks_in_use" for a decode pool's
+    # resident sequences) instead of one conflated stream count.
+    metric: str = "ongoing_requests"
+    # Per-replica target for a custom metric (None: falls back to
+    # target_ongoing_requests, which only makes sense for metrics in
+    # comparable units).
+    target_value: Optional[float] = None
 
 
 # Scale/wake event history is BOUNDED (observability, not a ledger): a
@@ -70,6 +82,10 @@ class DeploymentInfo:
     scale_events: List[dict] = field(default_factory=list)
     wake_events: int = 0
     last_wake_latency_s: float = 0.0
+    # Custom autoscaling metric samples, id(replica) -> last value
+    # (polled on the telemetry thread; pruned with the replica list).
+    metric_values: Dict[int, float] = field(default_factory=dict)
+    last_metric_poll: float = 0.0
 
 
 class ServeController:
@@ -176,6 +192,11 @@ class ServeController:
             except Exception as exc:  # telemetry best-effort
                 log.debug("prefix-digest poll failed; routing uses "
                           "stale overlap scores: %r", exc)
+            try:
+                self._poll_autoscale_metrics()
+            except Exception as exc:  # telemetry best-effort
+                log.debug("autoscale-metric poll failed; scaling uses "
+                          "stale samples: %r", exc)
 
     def _poll_prefix_digests(self):
         """Refresh each prefix-capable deployment's replica digest
@@ -200,6 +221,39 @@ class ServeController:
                 except Exception as exc:  # telemetry best-effort
                     log.debug("replica prefix_digest probe failed: %r",
                               exc)
+
+    def _poll_autoscale_metrics(self):
+        """Refresh custom autoscaling metric samples: deployments whose
+        ``AutoscalingConfig.metric`` is not the router-side default ask
+        each replica for ``autoscale_metric(name)`` — off the request
+        path, on the same cadence and thread as the prefix polls. A
+        replica that fails the probe keeps its LAST sample until it is
+        pruned with the replica list (stale beats absent for a scaling
+        signal)."""
+        now = time.monotonic()
+        with self._lock:
+            infos = [i for i in self._deployments.values()
+                     if i.autoscaling is not None
+                     and i.autoscaling.metric != "ongoing_requests"
+                     and now - i.last_metric_poll
+                     > self._PREFIX_POLL_INTERVAL_S]
+        for info in infos:
+            info.last_metric_poll = now
+            metric = info.autoscaling.metric
+            replicas = list(info.replicas)
+            for r in replicas:
+                try:
+                    ref = r.handle_request.remote(
+                        "autoscale_metric", (metric,), {})
+                    info.metric_values[id(r)] = float(
+                        ray_tpu.get(ref, timeout=2.0))
+                except Exception as exc:  # telemetry best-effort
+                    log.debug("replica autoscale_metric probe failed: "
+                              "%r", exc)
+            live = {id(r) for r in replicas}
+            for k in list(info.metric_values):
+                if k not in live:
+                    del info.metric_values[k]
 
     def _reconcile_once(self):
         with self._reconcile_lock:
@@ -393,8 +447,21 @@ class ServeController:
             qlens = info.replica_set.queue_lengths()
             if not qlens:
                 continue
-            ongoing = sum(qlens) / len(qlens)
-            if (ongoing > cfg.target_ongoing_requests
+            if cfg.metric != "ongoing_requests":
+                # Custom pool signal (polled from the replicas): the
+                # per-replica average vs its own target. No samples yet
+                # -> hold steady rather than scale on a guess.
+                vals = list(info.metric_values.values())
+                if not vals:
+                    continue
+                ongoing = sum(vals) / len(vals)
+                target = (cfg.target_value
+                          if cfg.target_value is not None
+                          else cfg.target_ongoing_requests)
+            else:
+                ongoing = sum(qlens) / len(qlens)
+                target = cfg.target_ongoing_requests
+            if (ongoing > target
                     and info.num_replicas < cfg.max_replicas
                     and now - info.last_scale_change > cfg.upscale_delay_s):
                 _record_scale_event(info.scale_events, {
@@ -402,7 +469,7 @@ class ServeController:
                     "to": info.num_replicas + 1, "reason": "load"})
                 info.num_replicas += 1
                 info.last_scale_change = now
-            elif (ongoing < cfg.target_ongoing_requests / 2
+            elif (ongoing < target / 2
                   and info.num_replicas > cfg.min_replicas
                   and now - info.last_scale_change > cfg.downscale_delay_s):
                 if info.num_replicas == 1 and sum(qlens) > 0:

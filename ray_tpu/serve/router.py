@@ -180,6 +180,42 @@ class ReplicaSet:
         self.prefix_overlap_tokens += best_tokens
         return best
 
+    def plan_prefix(self, prefix_tokens) -> int:
+        """Best cached-prefix overlap (in TOKENS) any replica advertises
+        for this prompt — the disagg pairing layer's tail-skip plan: a
+        prefill replica ships only blocks past this overlap, betting the
+        prefix tier routes the decode stream onto the same winner.
+        Advisory only: the decode replica re-validates against its OWN
+        cache at graft time and a stale plan falls back, so over-
+        estimating here costs a re-prefill, never correctness."""
+        if prefix_tokens is None:
+            return 0
+        with self._lock:
+            sizes = {bs for bs, _ in self._prefix.values()}
+        if not sizes:
+            return 0
+        from ray_tpu.llm.kv_cache import chain_digests
+
+        digests_by_bs = {bs: chain_digests(prefix_tokens, bs)
+                         for bs in sizes}
+        best = 0
+        with self._lock:
+            for r in self._replicas:
+                ent = self._prefix.get(id(r))
+                if ent is None:
+                    continue
+                bs, dset = ent
+                digs = digests_by_bs.get(bs)
+                if digs is None:
+                    continue
+                overlap = 0
+                for d in digs:
+                    if d not in dset:
+                        break
+                    overlap += 1
+                best = max(best, overlap * bs)
+        return best
+
     # -------------------------------------------------------------- choose
     def choose(self, prefix_tokens=None, priority: int = 0) -> (int, Any):
         """Prefix-overlap scoring when ``prefix_tokens`` is given and a

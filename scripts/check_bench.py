@@ -89,6 +89,7 @@ DEFAULT_REQUIRED = ("cluster_fanout_1k.tasks_per_sec,"
                     "streaming.backpressured_items_per_sec,"
                     "llm_serving.continuous_tokens_per_sec,"
                     "llm_prefix.cached_tokens_per_sec,"
+                    "llm_disagg.p99_ttft_ratio,"
                     "chaos_slo.p99_ttft_under_kill,"
                     "ownership.head_rpcs_per_1k_objects,"
                     "elastic_slo.p99_ttft_under_scale,"

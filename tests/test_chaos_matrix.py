@@ -844,6 +844,226 @@ def test_api_chaos_reports_faults_kills_and_shedding():
         ray_tpu.shutdown()
 
 
+# --------------------------------------------------------------------------
+# Disaggregated-serving rows (PR 19): kills across the prefill->decode
+# pairing hop — the published-KV handoff, not just steady-state streams.
+# --------------------------------------------------------------------------
+def _disagg_engine_config():
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import EngineConfig
+    from ray_tpu.models import TransformerConfig
+
+    mcfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, dtype=jnp.float32)
+    return EngineConfig(model=mcfg, num_blocks=128, block_size=4,
+                        max_num_seqs=4)
+
+
+def test_matrix_prefill_kill_after_publish_x_decode_fallback():
+    """Row (prefill replica SIGKILL × disagg pairing): the prefill
+    replica dies AFTER publishing a ticket but BEFORE the decode pull.
+    The pull fails (the p2p payload died with its owner), the decode
+    replica falls back to a transparent LOCAL re-prefill and completes
+    the stream correctly; pool accounting balances on both sides —
+    zero leaked KV blocks, the fallback counted."""
+    from ray_tpu import serve
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+    from ray_tpu.llm.disagg import build_disagg_llm_app
+
+    ray_tpu.shutdown()
+    # Short pull timeout so the decode replica's doomed pull fails fast
+    # instead of stalling the default 10s; replicas inherit the env.
+    os.environ["RAY_TPU_LLM_DISAGG_PULL_TIMEOUT_S"] = "2.0"
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start()
+    try:
+        ecfg = _disagg_engine_config()
+        papp, dapp = build_disagg_llm_app(ecfg)
+        serve.run(papp, name="prefill")
+        serve.run(dapp, name="decode")
+        ph = serve.get_deployment_handle("llm-prefill")
+        dh = serve.get_deployment_handle("llm-decode")
+        prompt = [9, 8, 7, 6, 5]
+        req = {"prompt": prompt, "max_new_tokens": 6}
+
+        # The expected stream: the engines are weight-deterministic
+        # (same config seed), so a local engine is the oracle.
+        oracle = InferenceEngine(ecfg)
+        ref = list(oracle.generate(prompt, max_new_tokens=6))
+        oracle.shutdown()
+
+        # Publish without pulling. The DeploymentResponse frees its
+        # replica pin at result(); grab the pid BEFORE that.
+        resp = ph.options(method_name="prefill",
+                          stream=False).remote(dict(req))
+        victim = resp._replica
+        ticket = resp.result(timeout=60)
+        assert ticket["blocks"] > 0
+        pre_stats = ph.stats.remote().result(timeout=30)
+        assert pre_stats["kv_publications_outstanding"] == 1
+
+        killer = chaos.NodeKiller(
+            [chaos.pid_kill_target("prefill_replica",
+                                   lambda: victim._runtime.pid)],
+            seed=19, interval_s=(0.01, 0.02), max_kills=1)
+        with killer:
+            deadline = time.monotonic() + 5
+            while not killer.kills and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert [k for k in killer.kills if "error" not in k], \
+            "the prefill replica kill never fired"
+
+        # Barrier: the SIGKILL lands instantly but the payload's
+        # owner-death can take a beat to propagate — wait until the
+        # published object is actually unresolvable before decoding,
+        # otherwise the pull races ahead of the death and adopts.
+        deadline = time.monotonic() + 10
+        payload_dead = False
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get(ticket["ref"], timeout=0.5)
+            except Exception:  # noqa: BLE001 — any failure = dead owner
+                payload_dead = True
+                break
+            time.sleep(0.05)
+        assert payload_dead, "published KV payload survived its owner"
+
+        # Decode with the dead ticket: the pull must fail typed inside
+        # the replica and the SAME request complete via local
+        # re-prefill — transparent to the client.
+        toks = list(dh.options(stream=True).remote(
+            {**req, "_disagg": ticket}))
+        assert toks == ref, (toks, ref)
+        dst = dh.stats.remote().result(timeout=30)
+        assert dst["disagg_fallbacks"] == 1
+        assert dst["disagg_adopted"] == 0
+        assert dst["blocks_grafted"] == 0
+
+        # Decode side drains clean: nothing adopted, nothing leaked.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            dst = dh.stats.remote().result(timeout=30)
+            if dst["blocks_in_use"] == 0 and dst["running"] == 0:
+                break
+            time.sleep(0.2)
+        assert dst["blocks_in_use"] == 0, "decode side leaked KV blocks"
+
+        # Prefill side: the controller replaces the killed replica
+        # within the reconcile window, and the replacement's ledger is
+        # balanced — no publication outstanding, no held KV.
+        deadline = time.monotonic() + 20
+        pst = None
+        while time.monotonic() < deadline:
+            try:
+                pst = ph.stats.remote().result(timeout=30)
+                if pst["kv_publications_outstanding"] == 0 and \
+                        pst["blocks_in_use"] == 0:
+                    break
+            except Exception:  # noqa: BLE001 — pre-reconcile routing
+                pass
+            time.sleep(0.2)
+        assert pst is not None, "no prefill replica served after kill"
+        assert pst["kv_publications_outstanding"] == 0
+        assert pst["blocks_in_use"] == 0
+        assert pst["held_sequences"] == 0
+    finally:
+        os.environ.pop("RAY_TPU_LLM_DISAGG_PULL_TIMEOUT_S", None)
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_matrix_decode_kill_midstream_x_disagg_repair():
+    """Row (decode replica SIGKILL × disagg stream): the decode replica
+    dies mid-stream with the disagg plane armed. The client sees a
+    typed error (never a hang), re-pairs through the SAME handle —
+    fresh prefill ticket, replacement decode replica — and the retried
+    request completes token-identical; no publication leaks past the
+    episode on the prefill side."""
+    from ray_tpu import serve
+    from ray_tpu.llm import InferenceEngine
+    from ray_tpu.llm.disagg import DisaggHandle, build_disagg_llm_app
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start()
+    try:
+        ecfg = _disagg_engine_config()
+        papp, dapp = build_disagg_llm_app(ecfg)
+        serve.run(papp, name="prefill")
+        serve.run(dapp, name="decode")
+        h = DisaggHandle.from_deployments()
+        ph = serve.get_deployment_handle("llm-prefill")
+        prompt = [3, 4, 5, 6]
+        max_new = 60
+
+        oracle = InferenceEngine(ecfg)
+        ref = list(oracle.generate(prompt, max_new_tokens=max_new))
+        oracle.shutdown()
+
+        gen = h.stream({"prompt": prompt, "max_new_tokens": max_new})
+        assert next(gen) == ref[0]
+
+        ctl = serve.api.get_or_create_controller()
+
+        def decode_pid():
+            for r in ctl._deployments["llm-decode"].replicas:
+                pid = r._runtime.pid
+                if pid and pid != os.getpid():
+                    return pid
+            return None
+
+        killer = chaos.NodeKiller(
+            [chaos.pid_kill_target("decode_replica", decode_pid,
+                                   once=True)],
+            seed=23, interval_s=(0.01, 0.02), max_kills=1)
+        with killer:
+            t0 = time.monotonic()
+            with pytest.raises(Exception) as ei:
+                for _ in range(max_new + 5):
+                    next(gen)
+            assert not isinstance(ei.value, StopIteration)
+            assert time.monotonic() - t0 < 60, "death must be typed+fast"
+        assert [k for k in killer.kills if "error" not in k], \
+            "the decode replica kill never fired"
+
+        # Re-pair and complete: the same handle pairs a fresh prefill
+        # ticket with the replacement decode replica inside the
+        # reconcile window.
+        deadline = time.monotonic() + 20
+        toks, ok = None, False
+        while time.monotonic() < deadline and not ok:
+            try:
+                toks = list(h.stream({"prompt": prompt,
+                                      "max_new_tokens": max_new}))
+                ok = len(toks) == max_new
+            except Exception:  # noqa: BLE001 — pre-reconcile routing
+                time.sleep(0.2)
+        assert ok, "re-paired request never completed after the kill"
+        assert toks == ref, (toks[:8], ref[:8])
+
+        # Publish/ack lifecycle balanced on the prefill side: the dead
+        # pairing's publication is acked-or-expired, never leaked (the
+        # TTL backstop covers a decode death between publish and ack).
+        deadline = time.monotonic() + 35
+        pst = None
+        while time.monotonic() < deadline:
+            pst = ph.stats.remote().result(timeout=30)
+            if pst["kv_publications_outstanding"] == 0 and \
+                    pst["blocks_in_use"] == 0:
+                break
+            time.sleep(0.5)
+        assert pst["kv_publications_outstanding"] == 0, pst
+        assert pst["blocks_in_use"] == 0, "prefill side leaked held KV"
+        assert pst["kv_publishes"] >= 2
+        assert pst["kv_acks"] + pst["kv_expiries"] == \
+            pst["kv_publishes"]
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 # ==========================================================================
 # FULL SWEEP (slow): multi-process cluster cells — wire faults + daemon
 # kills composed over the cross-node task plane, data shuffle, workflows.

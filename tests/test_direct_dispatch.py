@@ -321,13 +321,15 @@ def test_check_bench_requires_cluster_metric(tmp_path):
     # adds llm_serving.continuous_tokens_per_sec, PR 7 adds
     # llm_prefix.cached_tokens_per_sec, PR 8 adds
     # chaos_slo.p99_ttft_under_kill, PR 10 adds the ownership
-    # flatness headline, PR 12 adds the elastic-episode TTFT, and
-    # PR 15 adds the head-failover blackout to the required set).
+    # flatness headline, PR 12 adds the elastic-episode TTFT, PR 15
+    # adds the head-failover blackout, and PR 19 adds the disagg
+    # TTFT ratio to the required set).
     def _green(**over):
         rec = {"cluster_fanout_1k": {"tasks_per_sec": 250.0},
                "streaming": {"backpressured_items_per_sec": 150.0},
                "llm_serving": {"continuous_tokens_per_sec": 1000.0},
                "llm_prefix": {"cached_tokens_per_sec": 400.0},
+               "llm_disagg": {"p99_ttft_ratio": 0.5},
                "chaos_slo": {"p99_ttft_under_kill": 30.0},
                "ownership": {"head_rpcs_per_1k_objects": 0.0},
                "elastic_slo": {"p99_ttft_under_scale": 20.0},
@@ -346,6 +348,13 @@ def test_check_bench_requires_cluster_metric(tmp_path):
     # failover episode.
     _write("BENCH_pr03.json",
            _green(head_failover={"skipped": "standby never promoted"}))
+    assert check_bench.main(["--dir", str(tmp_path)]) == 1
+    # Missing the disagg-serving TTFT ratio (suite skipped) -> fails:
+    # a record cannot silently drop the disagg episode. The ratio is
+    # presence-gated only — its <= 0.7 SLO is asserted inside the
+    # suite itself, where a miss captures a debug bundle.
+    _write("BENCH_pr03.json",
+           _green(llm_disagg={"skipped": "serve spin-up failed"}))
     assert check_bench.main(["--dir", str(tmp_path)]) == 1
     # Flatness is an ABSOLUTE gate: a head back in the object plane
     # (nonzero marginal RPCs per 1k objects) fails even with no prior.

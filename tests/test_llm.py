@@ -878,3 +878,261 @@ def test_llm_engine_observability_state_and_dashboard(params):
     finally:
         dash.shutdown()
     engine.shutdown()
+
+
+# -------------------- disaggregated prefill/decode (engine-level, PR 19)
+def _drain_finished(req, timeout=30):
+    """Consume one request's output queue to completion; returns the
+    token list. The stream contract is uniform: tokens, then the
+    (_DONE, status) sentinel — adopted requests included."""
+    out = []
+    while True:
+        item = req.output_queue.get(timeout=timeout)
+        if isinstance(item, tuple):
+            kind, payload = item
+            if kind == "__error__":
+                raise payload
+            assert kind == "__done__" and payload == "FINISHED", item
+            return out
+        out.append(item)
+
+
+def test_hold_after_prefill_and_release_accounting(params):
+    """A held sequence keeps its KV resident past FINISHED (the
+    prefill-pool publish window); release_held frees it, idempotently,
+    and shutdown sweeps whatever is still held."""
+    engine = _engine(params)
+    prompt = list(range(1, 9))
+    req = engine.submit(prompt, max_new_tokens=1,
+                        hold_after_prefill=True)
+    first = req.output_queue.get(timeout=30)
+    assert isinstance(first, int)
+    assert req.output_queue.get(timeout=30) == ("__done__", "FINISHED")
+    assert engine.held_count() == 1
+    assert engine.stats()["held_sequences"] == 1
+    held_blocks = engine.cache.stats()["blocks_in_use"]
+    assert held_blocks > 0, "held sequence freed its KV"
+    # The held KV really is the finished prefill: exporting it works.
+    payload = engine.cache.export_blocks(req.seq_id, start_block=0)
+    assert payload["blocks"] > 0
+    assert engine.release_held(req.seq_id) > 0
+    assert engine.release_held(req.seq_id) == 0  # idempotent
+    assert engine.held_count() == 0
+    assert engine.cache.stats()["blocks_in_use"] == 0
+    # Shutdown sweep: a still-held sequence does not leak at teardown.
+    req2 = engine.submit(prompt, max_new_tokens=1,
+                         hold_after_prefill=True)
+    _drain_finished(req2)
+    assert engine.held_count() == 1
+    engine.shutdown()
+    assert engine.held_count() == 0
+
+
+def test_kv_export_graft_adopt_continuation_parity(params):
+    """The disagg hop at engine level: prefill on engine A (held),
+    export blocks, adopt on engine B (graft + commit), stream — the
+    decode-side tokens must equal a colocated run of the same request.
+    Covers the full-ship, cached-prefix, and tail-only-ship paths, and
+    asserts zero leaked blocks on both sides."""
+    pre, dec, base = _engine(params), _engine(params), _engine(params)
+    prompt = [5, 6, 7, 8, 9, 10, 11]
+    ref = list(base.generate(prompt, max_new_tokens=8))
+    base.shutdown()
+
+    # Full ship: decode side has nothing cached.
+    held = pre.submit(prompt, max_new_tokens=1, hold_after_prefill=True)
+    first = held.output_queue.get(timeout=30)
+    assert held.output_queue.get(timeout=30)[1] == "FINISHED"
+    payload = pre.cache.export_blocks(held.seq_id, start_block=0)
+    areq = dec.begin_adopted(prompt, max_new_tokens=8)
+    assert areq is not None and areq.cached_prompt_tokens == 0
+    assert dec.adopt_kv(areq, payload)
+    blocks, nbytes = areq.kv_ship
+    assert blocks == payload["blocks"] and nbytes > 0
+    dec.commit_adopted(areq, first)
+    assert _drain_finished(areq) == ref
+    decomp = dec.ttft_decomposition()
+    assert decomp["transfer_p50_s"] is not None
+    assert decomp["transfer_p50_s"] >= 0
+
+    # Cached-prefix adoption: the same prompt again — begin_adopted
+    # finds the registered prefix, so the graft starts past it.
+    areq2 = dec.begin_adopted(prompt, max_new_tokens=8)
+    assert areq2 is not None and areq2.cached_prompt_tokens > 0
+    assert dec.adopt_kv(areq2, payload)
+    dec.commit_adopted(areq2, first)
+    assert _drain_finished(areq2) == ref
+
+    # Tail-only ship: export FROM the decode side's cached boundary —
+    # the wire carries strictly fewer blocks than the full payload.
+    held3 = pre.submit(prompt, max_new_tokens=1,
+                       hold_after_prefill=True)
+    f3 = held3.output_queue.get(timeout=30)
+    held3.output_queue.get(timeout=30)
+    areq3 = dec.begin_adopted(prompt, max_new_tokens=8)
+    graft_from = areq3.cached_prompt_tokens // dec.cache.block_size
+    assert graft_from > 0
+    tail = pre.cache.export_blocks(held3.seq_id,
+                                   start_block=graft_from)
+    assert tail["blocks"] < payload["blocks"]
+    pre.release_held(held3.seq_id)
+    assert dec.adopt_kv(areq3, tail)
+    dec.commit_adopted(areq3, f3)
+    assert _drain_finished(areq3) == ref
+
+    pre.release_held(held.seq_id)
+    assert dec.wait_idle(30)
+    assert pre.cache.stats()["blocks_in_use"] == 0
+    assert dec.cache.stats()["blocks_in_use"] == 0
+    assert pre.cache.stats()["blocks_exported"] > 0
+    assert dec.cache.stats()["blocks_grafted"] > 0
+    pre.shutdown()
+    dec.shutdown()
+
+
+def test_adopt_kv_refuses_stale_plan_and_aborts_clean(params):
+    """A payload exported past the decode side's actual cached boundary
+    (stale tail-skip plan) is REFUSED — adopt_kv returns False, the
+    caller aborts, and nothing leaks."""
+    pre, dec = _engine(params), _engine(params)
+    prompt = [5, 6, 7, 8, 9, 10, 11]
+    held = pre.submit(prompt, max_new_tokens=1, hold_after_prefill=True)
+    held.output_queue.get(timeout=30)
+    held.output_queue.get(timeout=30)
+    payload = pre.cache.export_blocks(held.seq_id, start_block=1)
+    areq = dec.begin_adopted(prompt, max_new_tokens=8)
+    assert areq is not None
+    # Decode side caches nothing -> graft boundary 0 < start_block 1.
+    assert not dec.adopt_kv(areq, payload)
+    dec.abort_adopted(areq)
+    assert dec.cache.stats()["blocks_in_use"] == 0
+    assert dec.stats()["running"] == 0
+    pre.release_held(held.seq_id)
+    assert pre.cache.stats()["blocks_in_use"] == 0
+    pre.shutdown()
+    dec.shutdown()
+
+
+def test_publish_ttl_expiry_zero_leak(params, ray_start_regular,
+                                      monkeypatch):
+    """A publication never acked (decode replica died before pulling)
+    expires on the TTL deadline: counters record it and the held KV
+    blocks are freed — the publish/ack lifecycle cannot leak."""
+    monkeypatch.setenv("RAY_TPU_LLM_KV_PUBLISH_TTL_S", "0.2")
+    from ray_tpu.llm.disagg import PrefillLLMServer
+
+    ps = PrefillLLMServer(
+        EngineConfig(model=MODEL, num_blocks=48, block_size=4,
+                     max_num_seqs=4), params=params)
+    try:
+        ticket = ps.prefill({"prompt": [3, 4, 5, 6, 7],
+                             "max_new_tokens": 8})
+        st = ps.stats()
+        assert st["kv_publishes"] == 1
+        assert st["kv_publications_outstanding"] == 1
+        assert st["blocks_in_use"] > 0
+        time.sleep(0.25)
+        freed = ps.expire_published()
+        assert freed > 0
+        st = ps.stats()
+        assert st["kv_expiries"] == 1
+        assert st["kv_blocks_expired"] > 0
+        assert st["kv_publications_outstanding"] == 0
+        assert st["blocks_in_use"] == 0
+        assert st["held_sequences"] == 0
+        # A late ack (the decode side finally pulled a dead ticket) is
+        # an idempotent no-op, not a double free.
+        assert ps.ack(ticket["pub_id"]) == 0
+        assert ps.stats()["kv_acks"] == 0
+    finally:
+        ps.engine.shutdown()
+
+
+# --------------------------------- speculative decoding (PR 19)
+def test_spec_decode_greedy_parity_across_pow2_buckets(params):
+    """Speculative decoding is an EXACT greedy transform: with a draft
+    that mostly disagrees (independent random weights), every batch
+    bucket (1, 2, 4 = pow2 pads of 1/2/3 concurrent requests) must
+    produce token-for-token the vanilla engine's output."""
+    from ray_tpu.models import draft_config
+
+    vanilla = _engine(params)
+    spec = _engine(params, spec_k=3, draft_model=draft_config(MODEL))
+    prompts = [[1 + (5 * i + j) % 60 for j in range(3 + 2 * i)]
+               for i in range(3)]
+    refs = [list(vanilla.generate(p, max_new_tokens=10))
+            for p in prompts]
+    for batch in (1, 2, 3):
+        with spec._lock:
+            reqs = [spec.submit(p, max_new_tokens=10)
+                    for p in prompts[:batch]]
+        assert spec.wait_idle(60)
+        for req, ref in zip(reqs, refs):
+            assert list(req.out_tokens) == ref, (
+                f"spec decode diverged at batch {batch}")
+    st = spec.stats()["spec"]
+    assert st["rounds"] > 0 and st["proposed"] > 0
+    assert 0.0 <= st["acceptance_rate"] < 1.0  # random draft: low
+    # Each round emits, per batch row, its accepted run + 1 bonus: the
+    # token total sits between the bonus floor and the per-row cap.
+    assert st["rounds"] <= st["emitted"] <= \
+        st["accepted"] + st["rounds"] * len(prompts)
+    vanilla.shutdown()
+    spec.shutdown()
+
+
+def test_spec_decode_shift_pair_accepts_everything():
+    """Acceptance-rate counters: a draft/flagship pair that agree by
+    construction (synthetic shift models — greedy next token is
+    (t + 1) % vocab for both) accept every proposal, and each round
+    emits k accepted + 1 bonus token."""
+    from ray_tpu.models import (TransformerConfig as TC, draft_config,
+                                shift_params)
+
+    cfg = TC(vocab_size=16, d_model=32, n_layers=2, n_heads=4,
+             n_kv_heads=2, d_ff=48, dtype=jnp.float32)
+    dcfg = draft_config(cfg)
+    k = 3
+    spec = InferenceEngine(
+        EngineConfig(model=cfg, num_blocks=48, block_size=4,
+                     max_num_seqs=2, spec_k=k, draft_model=dcfg),
+        params=shift_params(cfg, shift=1),
+        draft_params=shift_params(dcfg, shift=1))
+    out = list(spec.generate([3], max_new_tokens=12))
+    assert out == [(3 + 1 + i) % 16 for i in range(12)]
+    st = spec.stats()["spec"]
+    assert st["acceptance_rate"] == 1.0
+    assert st["accepted"] == st["proposed"]
+    assert st["fallback_rounds"] == 0
+    spec.shutdown()
+
+
+def test_spec_decode_fallback_to_vanilla(params):
+    """spec_k=0 or a missing draft model disarm speculation entirely
+    (no 'spec' stats key, plain decode path); a sampled request on an
+    armed engine falls back PER ROUND and still matches the vanilla
+    engine's sampled stream seed-for-seed."""
+    from ray_tpu.models import draft_config
+
+    # Disarmed: spec_k=0 even with a draft model present.
+    e0 = _engine(params, spec_k=0, draft_model=draft_config(MODEL))
+    assert "spec" not in e0.stats()
+    # Disarmed: spec_k>0 but no draft model.
+    e1 = _engine(params, spec_k=3)
+    assert "spec" not in e1.stats()
+    ref = list(e0.generate([2, 3, 4], max_new_tokens=6))
+    assert list(e1.generate([2, 3, 4], max_new_tokens=6)) == ref
+    e0.shutdown()
+    e1.shutdown()
+
+    # Armed engine, sampled request: per-round fallback, seeded parity.
+    vanilla = _engine(params)
+    spec = _engine(params, spec_k=3, draft_model=draft_config(MODEL))
+    want = list(vanilla.generate([7, 8, 9], max_new_tokens=8,
+                                 temperature=0.7, seed=123))
+    got = list(spec.generate([7, 8, 9], max_new_tokens=8,
+                             temperature=0.7, seed=123))
+    assert got == want
+    assert spec.stats()["spec"]["fallback_rounds"] > 0
+    vanilla.shutdown()
+    spec.shutdown()

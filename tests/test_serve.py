@@ -427,6 +427,74 @@ def test_llm_app_streaming_cancellation_and_http():
         stop_proxy()
 
 
+def test_disagg_llm_pairing_end_to_end():
+    """Disaggregated serving through the real serve plane: prefill pool
+    publishes KV p2p, decode pool adopts and streams — token parity
+    with a colocated deployment, balanced publish/ack ledger, the
+    transfer phase in the TTFT decomposition, tail-skip on a shared
+    prefix, and the dead-ticket local-re-prefill fallback."""
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import EngineConfig, build_llm_app
+    from ray_tpu.llm.disagg import DisaggHandle, build_disagg_llm_app
+    from ray_tpu.models import TransformerConfig
+
+    mcfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, dtype=jnp.float32)
+    cfg = EngineConfig(model=mcfg, num_blocks=128, block_size=4,
+                      max_num_seqs=4)
+    ref = serve.run(build_llm_app(cfg, name="llm-ref"), name="ref")
+    prompt = [1, 2, 3, 4, 5]
+    req = {"prompt": prompt, "max_new_tokens": 8}
+    ref_toks = list(ref.options(stream=True).remote(dict(req)))
+    assert len(ref_toks) == 8
+
+    papp, dapp = build_disagg_llm_app(cfg)
+    serve.run(papp, name="prefill")
+    serve.run(dapp, name="decode")
+    h = DisaggHandle.from_deployments()
+    assert list(h.stream(dict(req))) == ref_toks
+    assert h.paired == 1 and h.prefill_fallbacks == 0
+
+    # Shared prefix, planned tail-skip: the decode pool caches the
+    # first prompt now, so the second ships only the unshared tail.
+    req2 = {"prompt": prompt + [9, 9], "max_new_tokens": 8}
+    ref2 = list(ref.options(stream=True).remote(dict(req2)))
+    assert list(h.stream_planned(dict(req2), cfg.block_size)) == ref2
+
+    ph = serve.get_deployment_handle("llm-prefill")
+    dh = serve.get_deployment_handle("llm-decode")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = ph.stats.remote().result(timeout=30)
+        if st["kv_publications_outstanding"] == 0 and \
+                st["blocks_in_use"] == 0:
+            break
+        time.sleep(0.2)
+    assert st["kv_publishes"] == 2
+    assert st["kv_acks"] == st["kv_publishes"]
+    assert st["kv_publications_outstanding"] == 0
+    assert st["blocks_in_use"] == 0 and st["held_sequences"] == 0
+
+    dst = dh.stats.remote().result(timeout=30)
+    assert dst["disagg_adopted"] == 2 and dst["disagg_fallbacks"] == 0
+    assert dst["blocks_grafted"] > 0
+    decomp = dst["ttft_decomposition"]
+    assert decomp["completed"] == 2
+    assert decomp["transfer_p50_s"] is not None
+    assert decomp["transfer_p50_s"] >= 0
+
+    # Dead ticket (unresolvable ref) -> transparent local re-prefill.
+    plain = {"prompt": [7, 7, 7], "max_new_tokens": 5}
+    ref3 = list(ref.options(stream=True).remote(dict(plain)))
+    bad = {**plain, "_disagg": {
+        "ref": None, "first_token": ref3[0], "pub_id": 999,
+        "start_block": 0, "blocks": 1, "block_size": 4, "bytes": 0}}
+    assert list(dh.options(stream=True).remote(bad)) == ref3
+    assert dh.stats.remote().result(timeout=30)["disagg_fallbacks"] == 1
+
+
 def test_config_file_deploy(tmp_path):
     import json
 
